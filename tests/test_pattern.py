@@ -1,0 +1,170 @@
+import pytest
+
+from kyverno_tpu.engine import pattern
+from kyverno_tpu.engine.validate_pattern import match_pattern, PatternError
+
+
+class TestLeafPattern:
+    def test_bool(self):
+        assert pattern.validate(True, True)
+        assert not pattern.validate(False, True)
+        assert not pattern.validate('true', True)
+
+    def test_int(self):
+        assert pattern.validate(5, 5)
+        assert pattern.validate(5.0, 5)
+        assert not pattern.validate(5.5, 5)
+        assert pattern.validate('5', 5)
+        assert not pattern.validate('x', 5)
+        assert not pattern.validate(True, 5)
+
+    def test_float(self):
+        assert pattern.validate(5.5, 5.5)
+        assert pattern.validate(5, 5.0)
+        assert not pattern.validate(5, 5.5)
+        assert pattern.validate('5.5', 5.5)
+
+    def test_nil(self):
+        assert pattern.validate(None, None)
+        assert pattern.validate(0, None)
+        assert pattern.validate('', None)
+        assert pattern.validate(False, None)
+        assert not pattern.validate('x', None)
+        assert not pattern.validate({}, None)
+
+    def test_map_existence_only(self):
+        assert pattern.validate({'a': 1}, {'x': 99})
+        assert not pattern.validate('notmap', {'x': 99})
+
+    def test_string_equal_and_wildcard(self):
+        assert pattern.validate('nginx', 'nginx')
+        assert pattern.validate('nginx:1.2', 'nginx:*')
+        assert not pattern.validate('alpine', 'nginx*')
+
+    def test_string_or(self):
+        assert pattern.validate('a', 'a | b')
+        assert pattern.validate('b', 'a | b')
+        assert not pattern.validate('c', 'a | b')
+
+    def test_string_and(self):
+        assert pattern.validate('5', '>1 & <10')
+        assert not pattern.validate('11', '>1 & <10')
+
+    def test_numeric_operators(self):
+        assert pattern.validate(8080, '>1024')
+        assert not pattern.validate(80, '>1024')
+        assert pattern.validate(10, '>=10')
+        assert pattern.validate(10, '<=10')
+        assert pattern.validate(9, '<10')
+        assert pattern.validate('512', '!1024')
+
+    def test_quantity_compare(self):
+        assert pattern.validate('100Mi', '<1Gi')
+        assert pattern.validate('2Gi', '>1G')
+        assert pattern.validate('1024Mi', '1Gi')
+        assert pattern.validate('100m', '<1')
+
+    def test_duration_compare(self):
+        assert pattern.validate('30s', '<1m')
+        assert pattern.validate('2h', '>30m')
+
+    def test_range(self):
+        assert pattern.validate(5, '1-10')
+        assert not pattern.validate(11, '1-10')
+        assert pattern.validate(11, '1!-10')
+        assert not pattern.validate(5, '1!-10')
+        assert pattern.validate('512Mi', '128Mi-1Gi')
+
+    def test_negation(self):
+        assert pattern.validate('b', '!a')
+        assert not pattern.validate('a', '!a')
+        assert not pattern.validate('nginx:latest', '!nginx:*')
+
+
+class TestMatchPattern:
+    def test_simple_match(self):
+        resource = {'spec': {'replicas': 3}}
+        match_pattern(resource, {'spec': {'replicas': '>1'}})
+
+    def test_simple_fail(self):
+        with pytest.raises(PatternError) as ei:
+            match_pattern({'spec': {'replicas': 1}}, {'spec': {'replicas': '>1'}})
+        assert not ei.value.skip
+
+    def test_missing_key_fails(self):
+        with pytest.raises(PatternError):
+            match_pattern({'spec': {}}, {'spec': {'replicas': '>1'}})
+
+    def test_star_requires_presence(self):
+        match_pattern({'metadata': {'labels': {'app': 'x'}}},
+                      {'metadata': {'labels': '*'}})
+        with pytest.raises(PatternError):
+            match_pattern({'metadata': {}}, {'metadata': {'labels': '*'}})
+
+    def test_array_of_maps(self):
+        resource = {'spec': {'containers': [
+            {'name': 'a', 'image': 'nginx:1'},
+            {'name': 'b', 'image': 'nginx:2'},
+        ]}}
+        match_pattern(resource, {'spec': {'containers': [{'image': 'nginx:*'}]}})
+        with pytest.raises(PatternError):
+            match_pattern(resource, {'spec': {'containers': [{'image': 'alpine:*'}]}})
+
+    def test_conditional_anchor_applies(self):
+        # if image is nginx:* then tag must not be latest
+        pat = {'spec': {'containers': [{'(image)': 'nginx:*', 'imagePullPolicy': 'Always'}]}}
+        ok = {'spec': {'containers': [{'image': 'nginx:1', 'imagePullPolicy': 'Always'}]}}
+        match_pattern(ok, pat)
+        bad = {'spec': {'containers': [{'image': 'nginx:1', 'imagePullPolicy': 'Never'}]}}
+        with pytest.raises(PatternError) as ei:
+            match_pattern(bad, pat)
+        assert not ei.value.skip
+
+    def test_conditional_anchor_skips(self):
+        pat = {'spec': {'(hostNetwork)': True, 'replicas': '>100'}}
+        # hostNetwork absent -> conditional anchor miss -> skip
+        with pytest.raises(PatternError) as ei:
+            match_pattern({'spec': {'replicas': 1}}, pat)
+        assert ei.value.skip
+
+    def test_conditional_anchor_value_mismatch_skips(self):
+        # anchor value doesn't match -> rule skipped
+        pat = {'spec': {'containers': [{'(image)': 'nginx:*', 'imagePullPolicy': 'Always'}]}}
+        res = {'spec': {'containers': [{'image': 'alpine', 'imagePullPolicy': 'Never'}]}}
+        with pytest.raises(PatternError) as ei:
+            match_pattern(res, pat)
+        assert ei.value.skip
+
+    def test_equality_anchor(self):
+        # =(key): if present must match, missing is fine
+        pat = {'metadata': {'=(annotations)': {'owner': '?*'}}}
+        match_pattern({'metadata': {}}, pat)
+        match_pattern({'metadata': {'annotations': {'owner': 'me'}}}, pat)
+        with pytest.raises(PatternError) as ei:
+            match_pattern({'metadata': {'annotations': {'owner': ''}}}, pat)
+        assert not ei.value.skip
+
+    def test_negation_anchor(self):
+        pat = {'spec': {'X(hostNetwork)': 'null'}}
+        match_pattern({'spec': {}}, pat)
+        with pytest.raises(PatternError) as ei:
+            match_pattern({'spec': {'hostNetwork': True}}, pat)
+        assert not ei.value.skip
+
+    def test_existence_anchor(self):
+        pat = {'spec': {'^(containers)': [{'name': 'istio-proxy'}]}}
+        match_pattern({'spec': {'containers': [{'name': 'app'}, {'name': 'istio-proxy'}]}}, pat)
+        with pytest.raises(PatternError):
+            match_pattern({'spec': {'containers': [{'name': 'app'}]}}, pat)
+
+    def test_scalar_array_pattern(self):
+        # each element of the resource list must match the scalar pattern
+        match_pattern({'ports': [80, 443]}, {'ports': [('>0')]})
+
+    def test_metadata_wildcard_expansion(self):
+        pat = {'metadata': {'labels': {'app.kubernetes.io/*': '?*'}}}
+        match_pattern({'metadata': {'labels': {'app.kubernetes.io/name': 'x'}}}, pat)
+
+    def test_type_mismatch(self):
+        with pytest.raises(PatternError):
+            match_pattern({'spec': 'str'}, {'spec': {'a': 1}})

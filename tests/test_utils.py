@@ -1,0 +1,85 @@
+from kyverno_tpu.utils import wildcard
+from kyverno_tpu.utils.duration import parse_duration, is_duration, format_duration
+from kyverno_tpu.utils.quantity import Quantity, is_quantity
+
+
+class TestWildcard:
+    def test_star(self):
+        assert wildcard.match('*', 's3:GetObject')
+        assert wildcard.match('s3:*', 's3:ListParts')
+        assert wildcard.match('my-bucket/In*', 'my-bucket/India/Karnataka/')
+        assert not wildcard.match('my-bucket/In*', 'my-bucket/Karnataka/India/')
+
+    def test_empty(self):
+        assert wildcard.match('', '')
+        assert not wildcard.match('', 'x')
+
+    def test_exact(self):
+        assert wildcard.match('s3:ListBucket', 's3:ListBucket')
+        assert not wildcard.match('s3:ListBucketMultipartUploads', 's3:ListBucket')
+
+    def test_question(self):
+        assert wildcard.match('a?c', 'abc')
+        assert not wildcard.match('a?c', 'ac')
+        assert wildcard.match('*.??m', 'x.com')
+
+    def test_multi_star(self):
+        assert wildcard.match('a*b*c', 'axxbyyc')
+        assert wildcard.match('*a*', 'za')
+        assert not wildcard.match('a*b*c', 'axxbyy')
+
+
+class TestQuantity:
+    def test_plain(self):
+        assert Quantity.parse('10').cmp(Quantity.parse('10')) == 0
+        assert Quantity.parse('9').cmp(Quantity.parse('10')) == -1
+
+    def test_binary_si(self):
+        assert Quantity.parse('1Ki').cmp(Quantity.parse('1024')) == 0
+        assert Quantity.parse('1Gi').cmp(Quantity.parse('1024Mi')) == 0
+
+    def test_decimal_si(self):
+        assert Quantity.parse('1500m').cmp(Quantity.parse('1.5')) == 0
+        assert Quantity.parse('1k').cmp(Quantity.parse('1000')) == 0
+        assert Quantity.parse('100m').cmp(Quantity.parse('0.1')) == 0
+
+    def test_exponent(self):
+        assert Quantity.parse('1e3').cmp(Quantity.parse('1000')) == 0
+        assert Quantity.parse('1.5E2').cmp(Quantity.parse('150')) == 0
+
+    def test_mixed_compare(self):
+        assert Quantity.parse('1Gi').cmp(Quantity.parse('1G')) == 1  # 2^30 > 10^9
+
+    def test_negative(self):
+        assert Quantity.parse('-1').cmp(Quantity.parse('1')) == -1
+
+    def test_invalid(self):
+        assert not is_quantity('abc')
+        assert not is_quantity('1XX')
+        assert is_quantity('10Mi')
+
+
+class TestDuration:
+    def test_basic(self):
+        assert parse_duration('1s') == 10**9
+        assert parse_duration('300ms') == 300 * 10**6
+        assert parse_duration('2h45m') == (2 * 3600 + 45 * 60) * 10**9
+        assert parse_duration('1.5h') == int(1.5 * 3600) * 10**9
+
+    def test_zero_and_sign(self):
+        assert parse_duration('0') == 0
+        assert parse_duration('-1m') == -60 * 10**9
+        assert parse_duration('+2s') == 2 * 10**9
+
+    def test_invalid(self):
+        assert not is_duration('10')   # missing unit
+        assert not is_duration('abc')
+        assert not is_duration('')
+        assert is_duration('10ns')
+
+    def test_format(self):
+        assert format_duration(0) == '0s'
+        assert format_duration(10**9) == '1s'
+        assert format_duration(90 * 10**9) == '1m30s'
+        assert format_duration(3661 * 10**9) == '1h1m1s'
+        assert format_duration(3600 * 10**9) == '1h0m0s'  # Go prints zero m/s
