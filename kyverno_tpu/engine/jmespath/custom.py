@@ -375,7 +375,8 @@ def _trunc_mod(a: int, b: int) -> int:
 def _fn_base64_decode(ip, args):
     s = _arg_str('base64_decode', args, 0)
     try:
-        return base64.b64decode(s, validate=True).decode('utf-8', 'replace')
+        # surrogateescape round-trips non-UTF-8 bytes like Go's string()
+        return base64.b64decode(s, validate=True).decode('utf-8', 'surrogateescape')
     except (binascii.Error, ValueError) as e:
         raise _err('base64_decode', str(e))
 
@@ -490,6 +491,11 @@ def _expand_wildcard(op: str, vs: str) -> List[Tuple[str, str]]:
         return [('<', lo)]
     if op == '<=':
         return [('<', hi)]
+    if op in ('!=', '!'):
+        # blang/semver expands !X.x to "<lo AND >=hi", an unsatisfiable
+        # range — reproduced bug-for-bug (its own test expects false for
+        # any input; reference: pkg/engine/jmespath/functions_test.go:1300)
+        return [('<', lo), ('>=', hi)]
     return [(op, lo)]
 
 
@@ -504,13 +510,15 @@ def _parse_range(rng: str):
         while i < len(tokens):
             term = tokens[i]
             # blang/semver accepts a space between operator and version
-            if re.fullmatch(r'>=|<=|!=|==|=|>|<', term) and i + 1 < len(tokens):
+            if re.fullmatch(r'>=|<=|!=|==|=|>|<|!', term) and i + 1 < len(tokens):
                 term = term + tokens[i + 1]
                 i += 2
             else:
                 i += 1
-            m = re.match(r'^(>=|<=|!=|==|=|>|<)?\s*(.+)$', term)
+            m = re.match(r'^(>=|<=|!=|==|=|>|<|!)?\s*(.+)$', term)
             op = m.group(1) or '='
+            if op == '!':
+                op = '!='
             vs = m.group(2)
             for op2, vs2 in _expand_wildcard(op if op != '==' else '=', vs):
                 v = _parse_semver(vs2)

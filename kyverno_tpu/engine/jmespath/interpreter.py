@@ -395,30 +395,37 @@ def _require_uniform_sortable(name, arr):
                             ['array-number', 'array-string'])
 
 
-def _sort_key(name):
-    def key_of(expref, element):
+def _sort_keys(name, expref, arr):
+    """Evaluate sort keys for every element, requiring a uniform
+    all-string or all-number key set (like go-jmespath)."""
+    keys = []
+    for element in arr:
         result = expref.visit(element)
         if not (isinstance(result, str) or _is_number(result)):
             raise JMESPathTypeError(name, result, jp_type(result),
                                     ['number', 'string'])
-        return result
-    return key_of
+        keys.append(result)
+    if not (all(isinstance(k, str) for k in keys) or
+            all(_is_number(k) for k in keys)):
+        raise JMESPathTypeError(name, keys, 'array',
+                                ['array-number', 'array-string'])
+    return keys
 
 
 def _fn_max_by(ip, args):
     arr, expref = args
     if not arr:
         return None
-    keyfn = _sort_key('max_by')
-    return max(arr, key=lambda x: keyfn(expref, x))
+    keys = _sort_keys('max_by', expref, arr)
+    return arr[max(range(len(arr)), key=lambda i: keys[i])]
 
 
 def _fn_min_by(ip, args):
     arr, expref = args
     if not arr:
         return None
-    keyfn = _sort_key('min_by')
-    return min(arr, key=lambda x: keyfn(expref, x))
+    keys = _sort_keys('min_by', expref, arr)
+    return arr[min(range(len(arr)), key=lambda i: keys[i])]
 
 
 def _fn_sort(ip, args):
@@ -431,8 +438,9 @@ def _fn_sort_by(ip, args):
     arr, expref = args
     if not arr:
         return list(arr)
-    keyfn = _sort_key('sort_by')
-    return sorted(arr, key=lambda x: keyfn(expref, x))
+    keys = _sort_keys('sort_by', expref, arr)
+    order = sorted(range(len(arr)), key=lambda i: keys[i])
+    return [arr[i] for i in order]
 
 
 def _fn_merge(ip, args):

@@ -1,0 +1,211 @@
+import pytest
+
+from kyverno_tpu.engine.context import Context, merge_patch
+from kyverno_tpu.engine import variables as vars_mod
+from kyverno_tpu.engine import operators as ops
+from kyverno_tpu.engine.variables import SubstitutionError
+
+
+class TestMergePatch:
+    def test_merge_objects(self):
+        assert merge_patch({'a': {'x': 1}}, {'a': {'y': 2}}) == {'a': {'x': 1, 'y': 2}}
+
+    def test_null_deletes(self):
+        assert merge_patch({'a': 1, 'b': 2}, {'a': None}) == {'b': 2}
+
+    def test_replace_non_objects(self):
+        assert merge_patch({'a': [1, 2]}, {'a': [3]}) == {'a': [3]}
+
+
+class TestContext:
+    def test_add_resource_and_query(self):
+        ctx = Context()
+        ctx.add_resource({'metadata': {'name': 'pod-1'}})
+        assert ctx.query('request.object.metadata.name') == 'pod-1'
+
+    def test_checkpoint_restore(self):
+        ctx = Context()
+        ctx.add_variable('x', 1)
+        ctx.checkpoint()
+        ctx.add_variable('x', 2)
+        assert ctx.query('x') == 2
+        ctx.restore()
+        assert ctx.query('x') == 1
+
+    def test_reset_keeps_checkpoint(self):
+        ctx = Context()
+        ctx.add_variable('x', 1)
+        ctx.checkpoint()
+        ctx.add_variable('x', 2)
+        ctx.reset()
+        assert ctx.query('x') == 1
+        ctx.add_variable('x', 3)
+        ctx.restore()
+        assert ctx.query('x') == 1
+
+    def test_add_element_nesting(self):
+        ctx = Context()
+        ctx.add_element({'image': 'nginx'}, 0, 0)
+        assert ctx.query('element.image') == 'nginx'
+        assert ctx.query('elementIndex') == 0
+        assert ctx.query('element0.image') == 'nginx'
+
+    def test_service_account(self):
+        ctx = Context()
+        ctx.add_service_account('system:serviceaccount:kube-system:builder')
+        assert ctx.query('serviceAccountName') == 'builder'
+        assert ctx.query('serviceAccountNamespace') == 'kube-system'
+
+    def test_has_changed(self):
+        ctx = Context()
+        ctx.add_resource({'spec': {'replicas': 2}})
+        ctx.add_old_resource({'spec': {'replicas': 1}})
+        assert ctx.has_changed('spec.replicas') is True
+        ctx2 = Context()
+        ctx2.add_resource({'spec': {'replicas': 1}})
+        ctx2.add_old_resource({'spec': {'replicas': 1}})
+        assert ctx2.has_changed('spec.replicas') is False
+
+
+class TestSubstitution:
+    def make_ctx(self):
+        ctx = Context()
+        ctx.add_resource({
+            'metadata': {'name': 'web', 'namespace': 'apps',
+                         'labels': {'app': 'web'}},
+            'spec': {'replicas': 3},
+        })
+        return ctx
+
+    def test_whole_leaf_variable_returns_raw(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(ctx, {'v': '{{request.object.spec.replicas}}'})
+        assert out == {'v': 3}
+
+    def test_string_splice(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(
+            ctx, {'msg': 'name is {{request.object.metadata.name}}!'})
+        assert out == {'msg': 'name is web!'}
+
+    def test_multiple_vars(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(
+            ctx, 'ns={{request.object.metadata.namespace}} app={{request.object.metadata.labels.app}}')
+        assert out == 'ns=apps app=web'
+
+    def test_escaped_variable(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(ctx, {'v': r'\{{ not a var }}'})
+        assert out == {'v': '{{ not a var }}'}
+
+    def test_non_string_splice_is_json(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(
+            ctx, 'labels={{request.object.metadata.labels}}')
+        assert out == 'labels={"app":"web"}'
+
+    def test_nested_variable_resolution(self):
+        ctx = self.make_ctx()
+        ctx.add_variable('inner', 'metadata.name')
+        out = vars_mod.substitute_all(ctx, '{{request.object.{{inner}}}}')
+        assert out == 'web'
+
+    def test_unresolved_variable_raises(self):
+        ctx = self.make_ctx()
+        with pytest.raises(SubstitutionError):
+            vars_mod.substitute_all(ctx, '{{unknown!!!bad}}')
+
+    def test_substitute_in_map_keys(self):
+        ctx = self.make_ctx()
+        out = vars_mod.substitute_all(
+            ctx, {'{{request.object.metadata.name}}-suffix': 1})
+        assert out == {'web-suffix': 1}
+
+    def test_reference_substitution(self):
+        doc = {'pattern': {'spec': {'replicas': '$(./../minReplicas)',
+                                    'minReplicas': '2'}}}
+        out = vars_mod.substitute_references(doc)
+        assert out['pattern']['spec']['replicas'] == '2'
+
+    def test_element_outside_foreach_rejected(self):
+        with pytest.raises(SubstitutionError):
+            vars_mod.validate_element_in_foreach(
+                {'validate': {'pattern': {'a': '{{element.image}}'}}})
+        # inside foreach is fine
+        vars_mod.validate_element_in_foreach(
+            {'validate': {'foreach': [{'pattern': {'a': '{{element.image}}'}}]}})
+
+
+class TestOperators:
+    def ev(self, key, operator, value):
+        return ops.evaluate(None, {'key': key, 'operator': operator, 'value': value})
+
+    def test_equals(self):
+        assert self.ev('a', 'Equals', 'a')
+        assert self.ev('abc', 'Equals', 'a*')  # wildcard in value
+        assert not self.ev('a', 'Equals', 'b')
+        assert self.ev(3, 'Equals', 3)
+        assert self.ev(3, 'Equals', '3')
+        assert self.ev('1Gi', 'Equals', '1024Mi')
+        assert self.ev('1h', 'Equals', '60m')
+        assert self.ev(True, 'Equals', True)
+        assert not self.ev(True, 'Equals', 'true')
+        assert self.ev({'a': 1}, 'Equals', {'a': 1})
+        assert self.ev([1, 2], 'Equals', [1, 2])
+
+    def test_not_equals(self):
+        assert self.ev('a', 'NotEquals', 'b')
+        assert not self.ev(3, 'NotEquals', 3)
+
+    def test_in_anyin(self):
+        assert self.ev('a', 'In', ['a', 'b'])
+        assert not self.ev('c', 'In', ['a', 'b'])
+        assert self.ev('nginx:1.2', 'AnyIn', ['nginx:*'])
+        assert self.ev(['a', 'x'], 'AnyIn', ['x', 'y'])
+        assert not self.ev(['a', 'b'], 'AnyIn', ['x', 'y'])
+        assert self.ev(['a', 'b'], 'AllIn', ['a', 'b', 'c'])
+        assert not self.ev(['a', 'z'], 'AllIn', ['a', 'b', 'c'])
+
+    def test_notin_family(self):
+        assert self.ev('c', 'NotIn', ['a', 'b'])
+        assert self.ev(['c'], 'AnyNotIn', ['a', 'b'])
+        assert not self.ev(['a'], 'AnyNotIn', ['a'])
+        assert self.ev(['c', 'd'], 'AllNotIn', ['a', 'b'])
+
+    def test_in_json_string_value(self):
+        assert self.ev('a', 'In', '["a", "b"]')
+
+    def test_anyin_range(self):
+        assert self.ev(5, 'AnyIn', '1-10')
+        assert not self.ev(50, 'AnyIn', '1-10')
+        assert self.ev([5, 100], 'AnyIn', '1-10')
+
+    def test_numeric(self):
+        assert self.ev(8080, 'GreaterThan', 1024)
+        assert not self.ev(80, 'GreaterThan', 1024)
+        assert self.ev(10, 'GreaterThanOrEquals', 10)
+        assert self.ev(1, 'LessThan', 2)
+        assert self.ev('512Mi', 'LessThan', '1Gi')
+        assert self.ev('2h', 'GreaterThan', '90m')
+        assert self.ev('1.2.3', 'GreaterThan', '1.0.0')  # semver
+        assert self.ev('8', 'LessThanOrEquals', 8)
+
+    def test_duration_deprecated(self):
+        assert self.ev(3600, 'DurationGreaterThanOrEquals', '1h')
+        assert self.ev('30m', 'DurationLessThan', 3600)
+
+    def test_condition_blocks(self):
+        conds = {'any': [
+            {'key': 'a', 'operator': 'Equals', 'value': 'x'},
+            {'key': 'b', 'operator': 'Equals', 'value': 'b'},
+        ]}
+        assert ops.evaluate_conditions(None, conds)
+        conds_all = {'all': [
+            {'key': 'a', 'operator': 'Equals', 'value': 'a'},
+            {'key': 'b', 'operator': 'Equals', 'value': 'x'},
+        ]}
+        assert not ops.evaluate_conditions(None, conds_all)
+        # legacy list form
+        assert ops.evaluate_conditions(None, [
+            {'key': 'a', 'operator': 'Equals', 'value': 'a'}])

@@ -1,0 +1,378 @@
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+from kyverno_tpu.engine.engine import Engine
+
+
+def run(policy_yaml, resource, **kw):
+    policy = Policy(yaml.safe_load(policy_yaml))
+    pctx = PolicyContext(policy, new_resource=resource, **kw)
+    return Engine().validate(pctx)
+
+
+DISALLOW_LATEST = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: disallow-latest-tag
+spec:
+  validationFailureAction: Enforce
+  rules:
+    - name: require-image-tag
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        message: "An image tag is required."
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+"""
+
+
+def pod(containers, kind='Pod', name='test-pod', labels=None):
+    return {
+        'apiVersion': 'v1', 'kind': kind,
+        'metadata': {'name': name, 'namespace': 'default',
+                     **({'labels': labels} if labels else {})},
+        'spec': {'containers': containers},
+    }
+
+
+class TestValidatePattern:
+    def test_pass(self):
+        resp = run(DISALLOW_LATEST, pod([{'name': 'a', 'image': 'nginx:1.25'}]))
+        assert len(resp.policy_response.rules) == 1
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        assert r.message == "validation rule 'require-image-tag' passed."
+
+    def test_fail_message_format(self):
+        resp = run(DISALLOW_LATEST, pod([{'name': 'a', 'image': 'nginx:latest'}]))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        assert r.message.startswith(
+            'validation error: An image tag is required. rule '
+            'require-image-tag failed at path')
+        assert not resp.is_successful()
+
+    def test_no_match_no_rules(self):
+        resp = run(DISALLOW_LATEST, {
+            'apiVersion': 'v1', 'kind': 'Service',
+            'metadata': {'name': 's', 'namespace': 'default'}, 'spec': {}})
+        assert resp.is_empty()
+
+
+PRECONDITIONS = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: check-replicas
+spec:
+  rules:
+    - name: check-replicas
+      match:
+        any:
+          - resources:
+              kinds: [Deployment]
+      preconditions:
+        all:
+          - key: "{{request.object.metadata.labels.critical || ''}}"
+            operator: Equals
+            value: "true"
+      validate:
+        message: "critical deployments need >= 2 replicas"
+        pattern:
+          spec:
+            replicas: ">=2"
+"""
+
+
+def deployment(replicas, labels=None):
+    return {
+        'apiVersion': 'apps/v1', 'kind': 'Deployment',
+        'metadata': {'name': 'd', 'namespace': 'default',
+                     **({'labels': labels} if labels else {})},
+        'spec': {'replicas': replicas,
+                 'template': {'metadata': {}, 'spec': {'containers': [
+                     {'name': 'c', 'image': 'nginx:1'}]}}},
+    }
+
+
+class TestPreconditions:
+    def test_skip_when_not_met(self):
+        resp = run(PRECONDITIONS, deployment(1))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.SKIP
+        assert r.message == 'preconditions not met'
+
+    def test_applies_when_met(self):
+        resp = run(PRECONDITIONS, deployment(1, labels={'critical': 'true'}))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        resp = run(PRECONDITIONS, deployment(3, labels={'critical': 'true'}))
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS
+
+
+DENY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: deny-delete
+spec:
+  rules:
+    - name: block-prod-deletes
+      match:
+        any:
+          - resources:
+              kinds: [ConfigMap]
+      validate:
+        message: "Deleting {{request.object.metadata.name}} is not allowed"
+        deny:
+          conditions:
+            any:
+              - key: "{{request.operation}}"
+                operator: Equals
+                value: DELETE
+"""
+
+
+class TestDeny:
+    def test_deny_fail(self):
+        # DELETE request: resource arrives as oldObject, newObject is empty
+        cm = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+              'metadata': {'name': 'cm1', 'namespace': 'default'}}
+        policy = Policy(yaml.safe_load(DENY))
+        pctx = PolicyContext(policy, old_resource=cm,
+                             admission_operation='DELETE')
+        resp = Engine().validate(pctx)
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        assert r.message == 'Deleting cm1 is not allowed'
+
+    def test_deny_pass(self):
+        cm = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+              'metadata': {'name': 'cm1', 'namespace': 'default'}}
+        resp = run(DENY, cm, admission_operation='CREATE')
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        assert r.message == "validation rule 'block-prod-deletes' passed."
+
+
+FOREACH = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: check-registries
+spec:
+  rules:
+    - name: check-registry
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        message: "unknown registry"
+        foreach:
+          - list: "request.object.spec.containers"
+            deny:
+              conditions:
+                all:
+                  - key: "{{element.image}}"
+                    operator: AnyNotIn
+                    value:
+                      - "ghcr.io/*"
+                      - "registry.k8s.io/*"
+"""
+
+
+class TestForeach:
+    def test_all_allowed(self):
+        resp = run(FOREACH, pod([
+            {'name': 'a', 'image': 'ghcr.io/org/app:1'},
+            {'name': 'b', 'image': 'registry.k8s.io/pause:3.9'}]))
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS
+
+    def test_one_denied(self):
+        resp = run(FOREACH, pod([
+            {'name': 'a', 'image': 'ghcr.io/org/app:1'},
+            {'name': 'b', 'image': 'docker.io/evil:1'}]))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        assert r.message.startswith('validation failure:')
+
+
+ANY_PATTERN = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-labels
+spec:
+  rules:
+    - name: require-team-label
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        message: "team label required"
+        anyPattern:
+          - metadata:
+              labels:
+                team: "?*"
+          - metadata:
+              labels:
+                squad: "?*"
+"""
+
+
+class TestAnyPattern:
+    def test_first_pattern(self):
+        resp = run(ANY_PATTERN, pod([{'name': 'a', 'image': 'x'}],
+                                    labels={'team': 'infra'}))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        assert 'anyPattern[0] passed' in r.message
+
+    def test_second_pattern(self):
+        resp = run(ANY_PATTERN, pod([{'name': 'a', 'image': 'x'}],
+                                    labels={'squad': 'infra'}))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        assert 'anyPattern[1] passed' in r.message
+
+    def test_none_fail(self):
+        resp = run(ANY_PATTERN, pod([{'name': 'a', 'image': 'x'}]))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        assert r.message.startswith('validation error: team label required.')
+
+
+AUTOGEN = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: disallow-latest
+spec:
+  rules:
+    - name: no-latest
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        message: "no latest tag"
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+"""
+
+
+class TestAutogen:
+    def test_deployment_autogen_rule_applies(self):
+        resp = run(AUTOGEN, deployment(1))
+        names = [r.name for r in resp.policy_response.rules]
+        assert 'autogen-no-latest' in names
+
+    def test_deployment_autogen_fails_on_latest(self):
+        d = deployment(1)
+        d['spec']['template']['spec']['containers'][0]['image'] = 'nginx:latest'
+        resp = run(AUTOGEN, d)
+        statuses = {r.name: r.status for r in resp.policy_response.rules}
+        assert statuses['autogen-no-latest'] == RuleStatus.FAIL
+
+    def test_cronjob_autogen(self):
+        cj = {
+            'apiVersion': 'batch/v1',
+            'kind': 'CronJob',
+            'metadata': {'name': 'cj', 'namespace': 'default'},
+            'spec': {'jobTemplate': {'spec': {'template': {'spec': {
+                'containers': [{'name': 'c', 'image': 'job:latest'}],
+            }}}}},
+        }
+        resp = run(AUTOGEN, cj)
+        statuses = {r.name: r.status for r in resp.policy_response.rules}
+        assert statuses.get('autogen-cronjob-no-latest') == RuleStatus.FAIL
+
+
+PSS_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: psa
+  annotations:
+    pod-policies.kyverno.io/autogen-controllers: none
+spec:
+  rules:
+    - name: baseline
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        podSecurity:
+          level: baseline
+          version: latest
+"""
+
+
+class TestPodSecurity:
+    def test_baseline_pass(self):
+        resp = run(PSS_POLICY, pod([{'name': 'a', 'image': 'nginx:1'}]))
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS
+
+    def test_privileged_fails(self):
+        resp = run(PSS_POLICY, pod([
+            {'name': 'a', 'image': 'nginx:1',
+             'securityContext': {'privileged': True}}]))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.FAIL
+        assert 'PodSecurity "baseline:latest"' in r.message
+        assert 'privileged' in r.message
+
+    def test_exclusion(self):
+        policy_yaml = PSS_POLICY.replace(
+            'version: latest',
+            'version: latest\n          exclude:\n'
+            '            - controlName: "Privileged Containers"\n'
+            '              images: ["nginx:*"]')
+        resp = run(policy_yaml, pod([
+            {'name': 'a', 'image': 'nginx:1',
+             'securityContext': {'privileged': True}}]))
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS
+
+
+EXCEPTION = {
+    'apiVersion': 'kyverno.io/v2alpha1', 'kind': 'PolicyException',
+    'metadata': {'name': 'ex-1', 'namespace': 'default'},
+    'spec': {
+        'exceptions': [{'policyName': 'disallow-latest-tag',
+                        'ruleNames': ['require-image-tag']}],
+        'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+    },
+}
+
+
+class TestExceptions:
+    def test_exception_skips_rule(self):
+        resp = run(DISALLOW_LATEST, pod([{'name': 'a', 'image': 'nginx:latest'}]),
+                   exceptions=[EXCEPTION])
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.SKIP
+        assert 'policy exception' in r.message
+
+
+class TestNamespacedPolicy:
+    def test_namespace_mismatch_skips(self):
+        p = yaml.safe_load(DISALLOW_LATEST)
+        p['kind'] = 'Policy'
+        p['metadata']['namespace'] = 'other'
+        policy = Policy(p)
+        pctx = PolicyContext(policy, new_resource=pod(
+            [{'name': 'a', 'image': 'nginx:latest'}]))
+        resp = Engine().validate(pctx)
+        assert resp.is_empty()
