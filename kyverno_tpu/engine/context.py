@@ -29,6 +29,10 @@ class InvalidVariableError(ContextError):
     pass
 
 
+class VariableNotFoundError(ContextError):
+    """Query resolved to a missing field (maps the fork's NotFoundError)."""
+
+
 def merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch (reference merges via
     jsonpatch.MergeMergePatches, pkg/engine/context/context.go:123)."""
@@ -146,6 +150,8 @@ class Context:
             raise InvalidVariableError(f'incorrect query {query}: {e}') from e
         try:
             return compiled.search(self._data)
+        except jp.NotFoundError as e:
+            raise VariableNotFoundError(str(e)) from e
         except jp.JMESPathError as e:
             raise ContextError(f'JMESPath query failed: {e}') from e
 

@@ -140,6 +140,11 @@ class Engine:
         self._build_response(policy_context, resp, start)
         return resp
 
+    def mutate(self, policy_context: PolicyContext) -> EngineResponse:
+        """reference: pkg/engine/mutation.go:24 Mutate"""
+        from .mutate.mutate import mutate as mutate_impl
+        return mutate_impl(self, policy_context)
+
     def apply_background_checks(self, policy_context: PolicyContext) -> EngineResponse:
         """Background-scan entry: same as validate but only if the policy has
         background enabled (reference: pkg/engine/background.go:20)."""
@@ -222,6 +227,11 @@ class Engine:
             return RuleResponse(rule.name, RuleType.VALIDATION,
                                 'manifest verification requires signatures',
                                 RuleStatus.ERROR)
+        if has_validate_image:
+            return RuleResponse(
+                rule.name, RuleType.IMAGE_VERIFY,
+                'image verification requires a registry client',
+                RuleStatus.ERROR)
         return None
 
     def _matches(self, rule: Rule, pctx: PolicyContext) -> bool:

@@ -20,9 +20,9 @@ from typing import Any
 
 from .custom import register_custom_functions
 from .errors import (ArityError, FunctionError, IncompleteExpressionError,
-                     JMESPathError, JMESPathTypeError, LexerError, ParseError,
-                     UnknownFunctionError)
-from .interpreter import (FunctionRegistry, TreeInterpreter,
+                     JMESPathError, JMESPathTypeError, LexerError,
+                     NotFoundError, ParseError, UnknownFunctionError)
+from .interpreter import (NOT_FOUND, FunctionRegistry, TreeInterpreter,
                           make_builtin_registry)
 from .parser import parse as parse_ast
 
@@ -30,6 +30,7 @@ __all__ = [
     'compile', 'search', 'parse_ast', 'JMESPathError', 'LexerError',
     'ParseError', 'IncompleteExpressionError', 'ArityError',
     'JMESPathTypeError', 'UnknownFunctionError', 'FunctionError',
+    'NotFoundError',
 ]
 
 _REGISTRY = register_custom_functions(make_builtin_registry())
@@ -44,7 +45,10 @@ class CompiledExpression:
         self.ast = ast
 
     def search(self, data: Any) -> Any:
-        return _INTERPRETER.visit(self.ast, data)
+        result = _INTERPRETER.visit(self.ast, data)
+        if result is NOT_FOUND:
+            raise NotFoundError(f'Unknown key "{self.expression}" in path')
+        return result
 
 
 @lru_cache(maxsize=16384)

@@ -51,3 +51,9 @@ class UnknownFunctionError(JMESPathError):
 
 class FunctionError(JMESPathError):
     """Raised by custom function implementations on bad input."""
+
+
+class NotFoundError(JMESPathError):
+    """The expression resolved to a missing field (kyverno/go-jmespath fork
+    behavior — reference: go.mod:342, pkg/engine/variables/vars.go:395)."""
+

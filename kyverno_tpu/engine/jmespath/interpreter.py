@@ -15,8 +15,37 @@ from .errors import (ArityError, FunctionError, JMESPathTypeError,
                      UnknownFunctionError)
 
 
+class _NotFound:
+    """Sentinel distinguishing a missing field from an explicit null.
+
+    The reference's jmespath dependency is the kyverno/go-jmespath fork
+    (reference: go.mod:342) whose Search returns NotFoundError when the
+    expression resolves to a missing field — engine code branches on it
+    (e.g. pkg/engine/variables/vars.go:395). The sentinel propagates
+    through the tree like null and is converted to NotFoundError at the
+    public search() boundary.
+    """
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return '<not-found>'
+
+    def __bool__(self):
+        return False
+
+
+NOT_FOUND = _NotFound()
+
+
+def _defined(value: Any) -> Any:
+    """Normalize NOT_FOUND to None for contexts that treat both as null."""
+    return None if value is NOT_FOUND else value
+
+
 def is_false(value: Any) -> bool:
     """JMESPath falsiness: null, empty string/array/object, and false."""
+    value = _defined(value)
     return (value is None or value is False or value == '' or
             (isinstance(value, (list, dict)) and len(value) == 0))
 
@@ -154,8 +183,8 @@ class TreeInterpreter:
 
     def _visit_field(self, node, value):
         if isinstance(value, dict):
-            return value.get(node['value'])
-        return None
+            return value.get(node['value'], NOT_FOUND)
+        return NOT_FOUND if value is NOT_FOUND else None
 
     # -- structural ----------------------------------------------------------
 
@@ -167,7 +196,7 @@ class TreeInterpreter:
 
     def _visit_index(self, node, value):
         if not isinstance(value, list):
-            return None
+            return NOT_FOUND if value is NOT_FOUND else None
         idx = node['value']
         try:
             return value[idx]
@@ -176,7 +205,7 @@ class TreeInterpreter:
 
     def _visit_slice(self, node, value):
         if not isinstance(value, list):
-            return None
+            return NOT_FOUND if value is NOT_FOUND else None
         start, stop, step = node['value']
         if step == 0:
             raise FunctionError('slice step cannot be 0')
@@ -191,10 +220,10 @@ class TreeInterpreter:
     def _visit_projection(self, node, value):
         base = self.visit(node['children'][0], value)
         if not isinstance(base, list):
-            return None
+            return NOT_FOUND if base is NOT_FOUND else None
         collected = []
         for element in base:
-            current = self.visit(node['children'][1], element)
+            current = _defined(self.visit(node['children'][1], element))
             if current is not None:
                 collected.append(current)
         return collected
@@ -202,10 +231,10 @@ class TreeInterpreter:
     def _visit_value_projection(self, node, value):
         base = self.visit(node['children'][0], value)
         if not isinstance(base, dict):
-            return None
+            return NOT_FOUND if base is NOT_FOUND else None
         collected = []
         for element in base.values():
-            current = self.visit(node['children'][1], element)
+            current = _defined(self.visit(node['children'][1], element))
             if current is not None:
                 collected.append(current)
         return collected
@@ -213,7 +242,7 @@ class TreeInterpreter:
     def _visit_flatten(self, node, value):
         base = self.visit(node['children'][0], value)
         if not isinstance(base, list):
-            return None
+            return NOT_FOUND if base is NOT_FOUND else None
         merged = []
         for element in base:
             if isinstance(element, list):
@@ -225,12 +254,12 @@ class TreeInterpreter:
     def _visit_filter_projection(self, node, value):
         base = self.visit(node['children'][0], value)
         if not isinstance(base, list):
-            return None
+            return NOT_FOUND if base is NOT_FOUND else None
         comparator = node['children'][2]
         collected = []
         for element in base:
             if is_truthy(self.visit(comparator, element)):
-                current = self.visit(node['children'][1], element)
+                current = _defined(self.visit(node['children'][1], element))
                 if current is not None:
                     collected.append(current)
         return collected
@@ -239,8 +268,8 @@ class TreeInterpreter:
 
     def _visit_comparator(self, node, value):
         op = node['value']
-        left = self.visit(node['children'][0], value)
-        right = self.visit(node['children'][1], value)
+        left = _defined(self.visit(node['children'][0], value))
+        right = _defined(self.visit(node['children'][1], value))
         if op in self.COMPARATOR_FUNC:
             return self.COMPARATOR_FUNC[op](left, right)
         # ordering operators are only valid for numbers
@@ -278,20 +307,22 @@ class TreeInterpreter:
     # -- multiselect ---------------------------------------------------------
 
     def _visit_multi_select_list(self, node, value):
-        if value is None:
+        if _defined(value) is None:
             return None
-        return [self.visit(child, value) for child in node['children']]
+        return [_defined(self.visit(child, value))
+                for child in node['children']]
 
     def _visit_multi_select_dict(self, node, value):
-        if value is None:
+        if _defined(value) is None:
             return None
-        return {child['value']: self.visit(child['children'][0], value)
+        return {child['value']: _defined(self.visit(child['children'][0], value))
                 for child in node['children']}
 
     # -- functions -----------------------------------------------------------
 
     def _visit_function_expression(self, node, value):
-        args = [self.visit(child, value) for child in node['children']]
+        args = [_defined(self.visit(child, value))
+                for child in node['children']]
         return self.functions.call(self, node['value'], args)
 
     def _visit_expref(self, node, value):

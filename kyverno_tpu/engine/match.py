@@ -40,44 +40,45 @@ def matches_resource_description(resource: Resource, rule, admission_info: Optio
 
     reasons: List[str] = []
 
+    def match_filter(f):
+        return _check_filter(f, resource, admission_info, exclude_group_roles,
+                             namespace_labels, subresource_in_review,
+                             allow_ephemeral=True, mode='match')
+
+    def exclude_filter(f):
+        return _check_filter(f, resource, admission_info, exclude_group_roles,
+                             namespace_labels, subresource_in_review,
+                             allow_ephemeral=True, mode='exclude')
+
     any_filters = match.get('any') or []
     all_filters = match.get('all') or []
     if any_filters:
-        if not any(not _check_filter(f, resource, admission_info, exclude_group_roles,
-                                     namespace_labels, subresource_in_review, allow_ephemeral=True)
-                   for f in any_filters):
+        if not any(not match_filter(f) for f in any_filters):
             reasons.append('no resource matched')
     elif all_filters:
         for f in all_filters:
-            reasons.extend(_check_filter(f, resource, admission_info, exclude_group_roles,
-                                         namespace_labels, subresource_in_review, allow_ephemeral=True))
+            reasons.extend(match_filter(f))
     else:
         f = {'resources': match.get('resources') or {},
              'roles': match.get('roles'), 'clusterRoles': match.get('clusterRoles'),
              'subjects': match.get('subjects')}
-        reasons.extend(_check_filter(f, resource, admission_info, exclude_group_roles,
-                                     namespace_labels, subresource_in_review,
-                                     allow_ephemeral=True, require_non_empty=True))
+        reasons.extend(match_filter(f))
 
     ex_any = exclude.get('any') or []
     ex_all = exclude.get('all') or []
     if ex_any:
         for f in ex_any:
-            if not _check_filter(f, resource, admission_info, exclude_group_roles,
-                                 namespace_labels, subresource_in_review, allow_ephemeral=True):
+            if not exclude_filter(f):
                 reasons.append('resource excluded since one of the criteria excluded it')
     elif ex_all:
-        if all(not _check_filter(f, resource, admission_info, exclude_group_roles,
-                                 namespace_labels, subresource_in_review, allow_ephemeral=True)
-               for f in ex_all):
+        if ex_all and all(not exclude_filter(f) for f in ex_all):
             reasons.append('resource excluded since the combination of all criteria exclude it')
     elif exclude:
         f = {'resources': exclude.get('resources') or {},
              'roles': exclude.get('roles'), 'clusterRoles': exclude.get('clusterRoles'),
              'subjects': exclude.get('subjects')}
         if not _filter_is_empty(f):
-            if not _check_filter(f, resource, admission_info, exclude_group_roles,
-                                 namespace_labels, subresource_in_review, allow_ephemeral=True):
+            if not exclude_filter(f):
                 reasons.append('resource excluded since one of the criteria excluded it')
 
     if reasons:
@@ -98,27 +99,33 @@ def _check_filter(f: dict, resource: Resource, admission_info: Optional[dict],
                   namespace_labels: Dict[str, str],
                   subresource_in_review: str,
                   allow_ephemeral: bool = False,
-                  require_non_empty: bool = False) -> List[str]:
-    """Return list of mismatch reasons (empty == filter matched)."""
+                  mode: str = 'match') -> List[str]:
+    """Return list of mismatch reasons (empty == filter matched).
+
+    ``mode='match'`` mirrors matchesResourceDescriptionMatchHelper
+    (reference: pkg/engine/utils.go:261): user info is ignored when there is
+    no admission info, and an empty filter is a non-match ("match cannot be
+    empty"). ``mode='exclude'`` mirrors the exclude helper (utils.go:276):
+    user info always applies and an empty filter never excludes."""
     errs: List[str] = []
     user_info = {'roles': f.get('roles'), 'clusterRoles': f.get('clusterRoles'),
                  'subjects': f.get('subjects')}
     has_user_info = any(user_info.values())
     res_desc = f.get('resources') or {}
-    if admission_info is None or not admission_info:
+    if mode == 'match' and (admission_info is None or not admission_info):
         has_user_info = False
         user_info = {}
-    if require_non_empty and not res_desc and not has_user_info:
-        return ['match cannot be empty']
     if res_desc or has_user_info:
         errs.extend(_check_resource_description(
             res_desc, resource, namespace_labels, subresource_in_review,
             allow_ephemeral))
         if has_user_info:
-            errs.extend(_check_user_info(user_info, admission_info,
+            errs.extend(_check_user_info(user_info, admission_info or {},
                                          exclude_group_roles))
-    elif require_non_empty:
-        errs.append('match cannot be empty')
+    else:
+        # empty filter: never matches (match) / never excludes (exclude)
+        errs.append('match cannot be empty' if mode == 'match'
+                    else 'exclude filter is empty')
     return errs
 
 

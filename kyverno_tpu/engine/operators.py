@@ -260,18 +260,19 @@ def _set_in(keys: List[str], value: Any, negate: bool) -> bool:
             if not isinstance(v, str):
                 return False
             vals.append(v)
-        found_all = all(k in set(vals) for k in keys)
-        missing_any = any(k not in set(vals) for k in keys)
-        return missing_any if negate else found_all
+        vals_set = set(vals)
+        missing_any = any(k not in vals_set for k in keys)
+        return missing_any if negate else not missing_any
     if isinstance(value, str):
         if len(keys) == 1 and keys[0] == value:
             return not negate
         arr = _value_as_string_list(value)
         if arr is None:
             return False
+        arr_set = set(arr)
         if negate:
-            return any(k not in set(arr) for k in keys)
-        return all(k in set(arr) for k in keys)
+            return any(k not in arr_set for k in keys)
+        return all(k in arr_set for k in keys)
     return False
 
 
@@ -333,9 +334,10 @@ def _any_set_in(keys: List[str], value: Any, negate: bool) -> bool:
         arr = _value_as_string_list(value)
         if arr is None:
             arr = [value]
+        arr_set = set(arr)
         if negate:
-            return any(k not in set(arr) for k in keys)
-        return any(k in set(arr) for k in keys)
+            return any(k not in arr_set for k in keys)
+        return any(k in arr_set for k in keys)
     return False
 
 
@@ -381,9 +383,10 @@ def _all_set_in(keys: List[str], value: Any, negate: bool) -> bool:
         arr = _value_as_string_list(value)
         if arr is None:
             arr = [value]
+        arr_set = set(arr)
         if negate:
-            return any(k not in set(arr) for k in keys)
-        return all(k in set(arr) for k in keys)
+            return any(k not in arr_set for k in keys)
+        return all(k in arr_set for k in keys)
     return False
 
 

@@ -376,3 +376,78 @@ class TestNamespacedPolicy:
             [{'name': 'a', 'image': 'nginx:latest'}]))
         resp = Engine().validate(pctx)
         assert resp.is_empty()
+
+
+EXCLUDE_SUBJECTS = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: exclude-admin
+spec:
+  rules:
+    - name: no-latest
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      exclude:
+        any:
+          - subjects:
+              - kind: User
+                name: admin
+      validate:
+        message: "no latest"
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+"""
+
+
+class TestExcludeSemantics:
+    def test_exclude_subjects_without_admission_info_does_not_exclude(self):
+        # background scan (no admission info): subject exclusion must NOT fire
+        resp = run(EXCLUDE_SUBJECTS, pod([{'name': 'a', 'image': 'x:latest'}]))
+        assert len(resp.policy_response.rules) == 1
+        assert resp.policy_response.rules[0].status == RuleStatus.FAIL
+
+    def test_exclude_subjects_matching_user_excludes(self):
+        resp = run(EXCLUDE_SUBJECTS, pod([{'name': 'a', 'image': 'x:latest'}]),
+                   admission_info={'userInfo': {'username': 'admin'}})
+        assert resp.is_empty()
+
+    def test_empty_match_any_filter_does_not_match(self):
+        p = yaml.safe_load(DISALLOW_LATEST)
+        p['spec']['rules'][0]['match'] = {'any': [{}]}
+        resp = Engine().validate(PolicyContext(
+            Policy(p), new_resource=pod([{'name': 'a', 'image': 'x:latest'}])))
+        assert resp.is_empty()
+
+
+UNRESOLVED_VAR = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: bad-var
+spec:
+  rules:
+    - name: check
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      validate:
+        message: "x"
+        pattern:
+          metadata:
+            name: "{{request.object.metadata.annotations.team}}"
+"""
+
+
+class TestUnresolvedVariables:
+    def test_unresolved_variable_errors_rule(self):
+        # pod without the annotation: substitution must ERROR (fork behavior)
+        resp = run(UNRESOLVED_VAR, pod([{'name': 'a', 'image': 'x'}]))
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.ERROR
+        assert 'variable substitution failed' in r.message

@@ -13,7 +13,19 @@ class TestCoreLanguage:
         assert s('a', {'a': 1}) == 1
         assert s('a.b.c', {'a': {'b': {'c': 'x'}}}) == 'x'
         assert s('a.b', {'a': 1}) is None
-        assert s('missing', {'a': 1}) is None
+
+    def test_missing_field_raises_not_found(self):
+        # kyverno/go-jmespath fork behavior: a missing field is an error,
+        # not null — this is what makes unresolved {{vars}} fail rules
+        from kyverno_tpu.engine.jmespath import NotFoundError
+        with pytest.raises(NotFoundError):
+            s('missing', {'a': 1})
+        with pytest.raises(NotFoundError):
+            s('a.b.c', {'a': {}})
+        # explicit null is NOT an error
+        assert s('a', {'a': None}) is None
+        # || rescues a missing field
+        assert s("missing || 'default'", {'a': 1}) == 'default'
 
     def test_quoted_field(self):
         assert s('"app.kubernetes.io/name"', {'app.kubernetes.io/name': 'x'}) == 'x'

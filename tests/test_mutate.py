@@ -1,0 +1,230 @@
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.mutate.jsonpatch import (apply_patch, generate_patches,
+                                                 load_patches)
+from kyverno_tpu.engine.mutate.strategic import (apply_strategic_merge_patch,
+                                                 strategic_merge)
+
+
+class TestJsonPatch:
+    def test_add_replace_remove(self):
+        doc = {'a': 1, 'b': {'c': [1, 2]}}
+        out = apply_patch(doc, [
+            {'op': 'add', 'path': '/d', 'value': 9},
+            {'op': 'replace', 'path': '/a', 'value': 2},
+            {'op': 'remove', 'path': '/b/c/0'},
+        ])
+        assert out == {'a': 2, 'b': {'c': [2]}, 'd': 9}
+        assert doc == {'a': 1, 'b': {'c': [1, 2]}}  # original untouched
+
+    def test_append(self):
+        out = apply_patch({'l': [1]}, [{'op': 'add', 'path': '/l/-', 'value': 2}])
+        assert out == {'l': [1, 2]}
+
+    def test_move_copy_test(self):
+        out = apply_patch({'a': 1}, [
+            {'op': 'copy', 'from': '/a', 'path': '/b'},
+            {'op': 'test', 'path': '/b', 'value': 1},
+            {'op': 'move', 'from': '/a', 'path': '/c'},
+        ])
+        assert out == {'b': 1, 'c': 1}
+
+    def test_escaped_pointer(self):
+        out = apply_patch({'metadata': {'annotations': {}}}, [
+            {'op': 'add', 'path': '/metadata/annotations/example.com~1key',
+             'value': 'v'}])
+        assert out['metadata']['annotations']['example.com/key'] == 'v'
+
+    def test_yaml_patch_text(self):
+        ops = load_patches("- op: add\n  path: /x\n  value: 1\n")
+        assert apply_patch({}, ops) == {'x': 1}
+
+    def test_diff_roundtrip(self):
+        a = {'x': 1, 'l': [1, 2, 3], 'm': {'k': 'v'}}
+        b = {'x': 2, 'l': [1, 9], 'm': {'k': 'v', 'n': True}}
+        ops = generate_patches(a, b)
+        assert apply_patch(a, ops) == b
+
+
+class TestStrategicMerge:
+    def test_map_merge(self):
+        base = {'metadata': {'labels': {'a': '1'}}}
+        patch = {'metadata': {'labels': {'b': '2'}}}
+        assert strategic_merge(base, patch) == {
+            'metadata': {'labels': {'a': '1', 'b': '2'}}}
+
+    def test_null_deletes(self):
+        out = strategic_merge({'a': 1, 'b': 2}, {'a': None})
+        assert out == {'b': 2}
+
+    def test_containers_merge_by_name(self):
+        base = {'spec': {'containers': [
+            {'name': 'app', 'image': 'nginx:1'},
+            {'name': 'sidecar', 'image': 'envoy:1'}]}}
+        patch = {'spec': {'containers': [
+            {'name': 'app', 'imagePullPolicy': 'Always'}]}}
+        out = strategic_merge(base, patch)
+        containers = out['spec']['containers']
+        assert containers[0] == {'name': 'app', 'image': 'nginx:1',
+                                 'imagePullPolicy': 'Always'}
+        assert containers[1]['name'] == 'sidecar'
+
+    def test_scalar_list_replaced(self):
+        out = strategic_merge({'l': [1, 2]}, {'l': [9]})
+        assert out == {'l': [9]}
+
+    def test_patch_delete_directive(self):
+        base = {'spec': {'containers': [{'name': 'a'}, {'name': 'b'}]}}
+        patch = {'spec': {'containers': [{'name': 'a', '$patch': 'delete'}]}}
+        out = strategic_merge(base, patch)
+        assert out['spec']['containers'] == [{'name': 'b'}]
+
+    def test_conditional_anchor_applies(self):
+        # set imagePullPolicy only where image is nginx:*
+        base = {'spec': {'containers': [
+            {'name': 'a', 'image': 'nginx:1'},
+            {'name': 'b', 'image': 'redis:7'}]}}
+        overlay = {'spec': {'containers': [
+            {'(image)': 'nginx:*', 'imagePullPolicy': 'IfNotPresent'}]}}
+        out = apply_strategic_merge_patch(base, overlay)
+        by_name = {c['name']: c for c in out['spec']['containers']}
+        assert by_name['a'].get('imagePullPolicy') == 'IfNotPresent'
+        assert 'imagePullPolicy' not in by_name['b']
+
+    def test_conditional_anchor_map_skips(self):
+        base = {'spec': {'hostNetwork': False}}
+        overlay = {'spec': {'(hostNetwork)': True, 'dnsPolicy': 'Default'}}
+        out = apply_strategic_merge_patch(base, overlay)
+        assert out == base  # condition failed → no change
+
+    def test_add_if_not_present(self):
+        base = {'metadata': {'labels': {'a': '1'}}}
+        overlay = {'metadata': {'labels': {'+(a)': 'X', '+(b)': '2'}}}
+        out = apply_strategic_merge_patch(base, overlay)
+        assert out['metadata']['labels'] == {'a': '1', 'b': '2'}
+
+
+MUTATE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-labels
+  annotations:
+    pod-policies.kyverno.io/autogen-controllers: none
+spec:
+  rules:
+    - name: add-team-label
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              +(team): default-team
+"""
+
+MUTATE_JSON6902 = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: set-replicas
+  annotations:
+    pod-policies.kyverno.io/autogen-controllers: none
+spec:
+  rules:
+    - name: bump
+      match:
+        any:
+          - resources:
+              kinds: [Deployment]
+      mutate:
+        patchesJson6902: |-
+          - op: replace
+            path: /spec/replicas
+            value: 3
+"""
+
+MUTATE_FOREACH = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: prepend-registry
+  annotations:
+    pod-policies.kyverno.io/autogen-controllers: none
+spec:
+  rules:
+    - name: prepend-registry-containers
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+      mutate:
+        foreach:
+          - list: "request.object.spec.containers"
+            patchesJson6902: |-
+              - op: replace
+                path: /spec/containers/{{elementIndex}}/image
+                value: "registry.io/{{ element.image }}"
+"""
+
+
+def run_mutate(policy_yaml, resource):
+    policy = Policy(yaml.safe_load(policy_yaml))
+    pctx = PolicyContext(policy, new_resource=resource)
+    return Engine().mutate(pctx)
+
+
+class TestEngineMutate:
+    def test_strategic_merge_add_label(self):
+        resp = run_mutate(MUTATE_POLICY, {
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'namespace': 'default'},
+            'spec': {'containers': [{'name': 'c', 'image': 'x'}]}})
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        assert resp.patched_resource['metadata']['labels'] == {
+            'team': 'default-team'}
+        assert any(p['path'] == '/metadata/labels' for p in r.patches)
+
+    def test_existing_label_untouched(self):
+        resp = run_mutate(MUTATE_POLICY, {
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'namespace': 'default',
+                         'labels': {'team': 'infra'}},
+            'spec': {'containers': [{'name': 'c', 'image': 'x'}]}})
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.SKIP  # no patches → skip
+        assert resp.patched_resource['metadata']['labels'] == {'team': 'infra'}
+
+    def test_json6902(self):
+        resp = run_mutate(MUTATE_JSON6902, {
+            'apiVersion': 'apps/v1', 'kind': 'Deployment',
+            'metadata': {'name': 'd', 'namespace': 'default'},
+            'spec': {'replicas': 1}})
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS
+        assert resp.patched_resource['spec']['replicas'] == 3
+
+    def test_foreach_mutation(self):
+        resp = run_mutate(MUTATE_FOREACH, {
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'namespace': 'default'},
+            'spec': {'containers': [
+                {'name': 'a', 'image': 'nginx:1'},
+                {'name': 'b', 'image': 'redis:7'}]}})
+        r = resp.policy_response.rules[0]
+        assert r.status == RuleStatus.PASS
+        images = [c['image'] for c in resp.patched_resource['spec']['containers']]
+        assert images == ['registry.io/nginx:1', 'registry.io/redis:7']
+
+    def test_mutate_then_validate_consistency(self):
+        # the patched resource re-enters the JSON context
+        resp = run_mutate(MUTATE_POLICY, {
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'namespace': 'default'},
+            'spec': {'containers': [{'name': 'c', 'image': 'x'}]}})
+        assert resp.patched_resource['metadata']['labels']['team'] == 'default-team'
