@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Benchmark: TPU-backed background scan vs host-engine baseline.
+
+Workload: a best-practices-style validate pack (image tags, resource
+requests/limits, conditional pull policy, host network, replicas) over
+synthetic Pods/Deployments — config 2 of BASELINE.md. The baseline is the
+host engine (this repo's reference-semantics interpreter) measured on the
+same machine, since the reference publishes no numbers (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, '.')
+
+from kyverno_tpu.api.policy import load_policies_from_yaml  # noqa: E402
+from kyverno_tpu.compiler.scan import BatchScanner  # noqa: E402
+from kyverno_tpu.engine.api import PolicyContext  # noqa: E402
+from kyverno_tpu.engine.engine import Engine  # noqa: E402
+
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: disallow-latest-tag
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: require-image-tag
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "An image tag is required."
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest & !*:unstable"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-resources
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: validate-resources
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "resource requests and limits are required"
+        pattern:
+          spec:
+            containers:
+              - resources:
+                  requests: {memory: "?*", cpu: "?*"}
+                  limits: {memory: "<=8Gi"}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: conditional-pull-policy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: latest-needs-always
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "latest images need Always pull policy"
+        pattern:
+          spec:
+            containers:
+              - (image): "*:latest"
+                imagePullPolicy: Always
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: no-host-namespaces
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: host-namespaces-off
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "host namespaces are not allowed"
+        pattern:
+          spec:
+            =(hostNetwork): false
+            =(hostPID): false
+            =(hostIPC): false
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-run-as-non-root
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: run-as-non-root
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "runAsNonRoot must be true"
+        pattern:
+          spec:
+            containers:
+              - =(securityContext):
+                  =(runAsNonRoot): true
+"""
+
+IMAGES = ['nginx:1.25.3', 'redis:7.2', 'ghcr.io/org/app:v1.4',
+          'registry.k8s.io/pause:3.9', 'envoy:v1.28', 'postgres:16.1']
+MEM = ['64Mi', '128Mi', '256Mi', '512Mi', '1Gi', '2Gi']
+CPU = ['50m', '100m', '250m', '500m', '1']
+
+
+def make_pod(rng, i):
+    containers = []
+    for c in range(rng.randint(1, 3)):
+        container = {
+            'name': f'c{c}',
+            'image': rng.choice(IMAGES) if rng.random() > 0.02
+            else 'bad:latest',
+            'imagePullPolicy': 'IfNotPresent',
+            'resources': {
+                'requests': {'memory': rng.choice(MEM),
+                             'cpu': rng.choice(CPU)},
+                'limits': {'memory': rng.choice(MEM)},
+            },
+        }
+        if rng.random() < 0.6:
+            container['securityContext'] = {'runAsNonRoot': True}
+        containers.append(container)
+    return {
+        'apiVersion': 'v1', 'kind': 'Pod',
+        'metadata': {'name': f'pod-{i}', 'namespace': f'ns-{i % 50}',
+                     'labels': {'app': f'app-{i % 100}'}},
+        'spec': {'containers': containers},
+    }
+
+
+def main():
+    n_device = int(float(__import__('os').environ.get('BENCH_N', 20000)))
+    n_host = 400
+    rng = random.Random(42)
+    resources = [make_pod(rng, i) for i in range(n_device)]
+
+    policies = load_policies_from_yaml(PACK)
+
+    # --- host baseline (reference-semantics interpreter) -------------------
+    engine = Engine()
+    t0 = time.perf_counter()
+    for r in resources[:n_host]:
+        for policy in policies:
+            engine.apply_background_checks(
+                PolicyContext(policy, new_resource=r))
+    host_elapsed = time.perf_counter() - t0
+    host_rate = (n_host * len(policies)) / host_elapsed
+
+    # --- TPU-backed scan ---------------------------------------------------
+    scanner = BatchScanner(policies)
+    assert not scanner.cps.host_rules, 'pack must fully compile'
+    # warmup: trigger jit compile on a small slice
+    scanner.scan(resources[:64])
+
+    t0 = time.perf_counter()
+    results = scanner.scan(resources)
+    elapsed = time.perf_counter() - t0
+    decisions = n_device * len(policies)
+    rate = decisions / elapsed
+
+    # sanity: spot-check equivalence on a sample
+    sample = random.Random(1).sample(range(n_device), 25)
+    for i in sample:
+        host = {}
+        for policy in policies:
+            resp = engine.apply_background_checks(
+                PolicyContext(policy, new_resource=resources[i]))
+            if resp.policy_response.rules:
+                host[policy.name] = {r.name: r.status
+                                     for r in resp.policy_response.rules}
+        got = {r.policy_response.policy_name:
+               {x.name: x.status for x in r.policy_response.rules}
+               for r in results[i] if r.policy_response.rules}
+        assert got == host, f'verdict divergence on resource {i}'
+
+    print(json.dumps({
+        'metric': 'background-scan admission decisions/sec',
+        'value': round(rate, 1),
+        'unit': 'decisions/s',
+        'vs_baseline': round(rate / host_rate, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,386 @@
+"""Policy-set compiler: validate rules → vectorized check programs.
+
+Compiles the vectorizable subset (pattern / anyPattern rules over scalar
+paths and one array-of-maps level, with conditional / equality / negation /
+existence anchors and the full string-operator grammar). Rules outside the
+subset — variables, context entries, preconditions, deny, foreach,
+podSecurity, nested arrays, metadata wildcards — fall back to the host
+engine, preserving exact semantics.
+
+The leaf compilation mirrors the reference's OR-chain coercions
+(reference: pkg/engine/pattern/pattern.go:207 validateString tries
+duration, then quantity, then wildcard string).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Any, List, Optional, Tuple
+
+from ..api.policy import Policy
+from ..autogen.autogen import compute_rules
+from ..engine import anchor as anchor_mod
+from ..engine import pattern as leaf_pattern
+from ..engine.variables import is_reference, is_variable
+from ..utils.duration import parse_duration
+from ..utils.quantity import Quantity
+from .ir import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT, CMP_NE, MAX_ELEMS,
+                 STR_LEN, BoolExpr, CompiledPolicySet, CompileError,
+                 ElementBlock, Leaf, RuleProgram, Slot)
+
+_CMP_OF_OP = {
+    leaf_pattern.OP_MORE: CMP_GT,
+    leaf_pattern.OP_MORE_EQUAL: CMP_GE,
+    leaf_pattern.OP_LESS: CMP_LT,
+    leaf_pattern.OP_LESS_EQUAL: CMP_LE,
+    leaf_pattern.OP_EQUAL: CMP_EQ,
+    leaf_pattern.OP_NOT_EQUAL: CMP_NE,
+}
+
+
+def compile_policies(policies: List[Policy]) -> CompiledPolicySet:
+    cps = CompiledPolicySet()
+    cps.policies = policies
+    for p_idx, policy in enumerate(policies):
+        for r_idx, rule in enumerate(compute_rules(policy)):
+            try:
+                program = _compile_rule(cps, policy, p_idx, r_idx, rule)
+            except CompileError:
+                cps.host_rules.append((p_idx, rule, policy))
+                continue
+            cps.programs.append(program)
+    return cps
+
+
+def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
+                  r_idx: int, rule: dict) -> RuleProgram:
+    if not rule.get('validate'):
+        raise CompileError('not a validate rule')
+    validate = rule['validate']
+    if rule.get('context') or rule.get('preconditions'):
+        raise CompileError('context/preconditions require the host engine')
+    unsupported = [k for k in ('deny', 'foreach', 'podSecurity', 'manifests')
+                   if validate.get(k) is not None]
+    if unsupported:
+        raise CompileError(f'unsupported validate type {unsupported}')
+    match = rule.get('match') or {}
+    _require_simple_match(match)
+    _require_simple_match(rule.get('exclude') or {})
+
+    name = rule.get('name', '')
+    if validate.get('pattern') is not None:
+        scalar, scalar_cond, blocks = _compile_pattern(
+            cps, validate['pattern'])
+        return RuleProgram(
+            policy_name=policy.name, rule_name=name,
+            policy_index=p_idx, rule_index=r_idx,
+            scalar=scalar, scalar_condition=scalar_cond,
+            elements=tuple(blocks),
+            pass_message=f"validation rule '{name}' passed.",
+            background=policy.background, rule_raw=rule)
+    if validate.get('anyPattern') is not None:
+        raise CompileError('anyPattern compiled per-sub-pattern in v2')
+    raise CompileError('no pattern')
+
+
+def _require_simple_match(match: dict) -> None:
+    """The device path precomputes match host-side; that host precompute
+    supports everything, so only sanity-check shape here."""
+    if not isinstance(match, dict):
+        raise CompileError('bad match block')
+
+
+def _check_no_vars(value: Any) -> None:
+    if isinstance(value, str) and (is_variable(value) or is_reference(value)):
+        raise CompileError(f'variable in pattern: {value!r}')
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _check_no_vars(k)
+            _check_no_vars(v)
+    if isinstance(value, list):
+        for v in value:
+            _check_no_vars(v)
+
+
+def _compile_pattern(cps: CompiledPolicySet, pattern: Any):
+    """Compile a pattern tree rooted at the resource."""
+    _check_no_vars(pattern)
+    if not isinstance(pattern, dict):
+        raise CompileError('top-level pattern must be a map')
+    scalar_parts: List[BoolExpr] = []
+    cond_parts: List[BoolExpr] = []
+    blocks: List[ElementBlock] = []
+    _walk_map(cps, pattern, (), scalar_parts, cond_parts, blocks)
+    scalar = BoolExpr.all(scalar_parts) if scalar_parts else None
+    cond = BoolExpr.all(cond_parts) if cond_parts else None
+    return scalar, cond, blocks
+
+
+def _walk_map(cps: CompiledPolicySet, pattern: dict, path: Tuple[str, ...],
+              scalar_parts: List[BoolExpr], cond_parts: List[BoolExpr],
+              blocks: List[ElementBlock]) -> None:
+    for key, value in pattern.items():
+        a = anchor_mod.parse(key)
+        bare = a.key if a else key
+        child_path = path + (bare,)
+        if a is not None and anchor_mod.is_global(a):
+            raise CompileError('global anchors not vectorized')
+        if a is not None and anchor_mod.is_condition(a):
+            # map-level conditional anchor: mismatch or missing → rule skip
+            if isinstance(value, (dict, list)):
+                raise CompileError('nested conditional anchors not vectorized')
+            cond_parts.append(_compile_leaf(cps, child_path, value,
+                                            missing_ok=False))
+            continue
+        if a is not None and anchor_mod.is_negation(a):
+            slot_id_path = child_path
+            scalar_parts.append(BoolExpr.of(
+                Leaf(Slot(slot_id_path), 'absent')))
+            continue
+        if a is not None and anchor_mod.is_existence(a):
+            if not isinstance(value, list) or not value or \
+                    not all(isinstance(e, dict) for e in value):
+                raise CompileError('existence anchor pattern must be a '
+                                   'list of maps')
+            for elem_pattern in value:
+                blocks.append(_compile_element_block(
+                    cps, child_path, elem_pattern, mode='exists'))
+            continue
+        missing_ok = a is not None and anchor_mod.is_equality(a)
+        if isinstance(value, dict):
+            if missing_ok:
+                raise CompileError('=() on maps not vectorized')
+            if _has_wildcard_key(value):
+                raise CompileError('wildcard keys not vectorized')
+            _walk_map(cps, value, child_path, scalar_parts, cond_parts,
+                      blocks)
+        elif isinstance(value, list):
+            if not value:
+                raise CompileError('empty pattern array')
+            first = value[0]
+            if isinstance(first, dict):
+                if len(value) != 1:
+                    raise CompileError('multi-element array patterns not '
+                                       'vectorized')
+                blocks.append(_compile_element_block(cps, child_path, first,
+                                                     mode='forall',
+                                                     missing_ok=missing_ok))
+            elif isinstance(first, (str, int, float, bool)) or first is None:
+                # every array element must match the scalar pattern
+                slot_path = child_path + ('*',)
+                constraint = _compile_leaf(cps, slot_path, first,
+                                           missing_ok=False)
+                blocks.append(ElementBlock(
+                    array_path=child_path, condition=None,
+                    constraint=constraint))
+            else:
+                raise CompileError('unsupported array pattern')
+        else:
+            scalar_parts.append(_compile_leaf(cps, child_path, value,
+                                              missing_ok=missing_ok))
+
+
+def _has_wildcard_key(pattern: dict) -> bool:
+    return any(('*' in k or '?' in k) for k in pattern)
+
+
+def _compile_element_block(cps: CompiledPolicySet, array_path: Tuple[str, ...],
+                           elem_pattern: dict, mode: str,
+                           missing_ok: bool = False) -> ElementBlock:
+    if missing_ok:
+        raise CompileError('=() array anchors not vectorized')
+    cond_parts: List[BoolExpr] = []
+    cons_parts: List[BoolExpr] = []
+    for key, value in elem_pattern.items():
+        a = anchor_mod.parse(key)
+        bare = a.key if a else key
+        slot_path = array_path + ('*', bare)
+        if a is not None and anchor_mod.is_condition(a):
+            if isinstance(value, (dict, list)):
+                raise CompileError('nested element conditions not vectorized')
+            cond_parts.append(_compile_leaf(cps, slot_path, value,
+                                            missing_ok=False))
+            continue
+        if a is not None and anchor_mod.is_negation(a):
+            cons_parts.append(BoolExpr.of(Leaf(Slot(slot_path), 'absent')))
+            continue
+        if a is not None and not anchor_mod.is_equality(a):
+            raise CompileError(f'anchor {key} not vectorized in elements')
+        missing_ok_leaf = a is not None and anchor_mod.is_equality(a)
+        if isinstance(value, dict):
+            # nested map inside element: flatten one extra level of scalars
+            _flatten_nested(cps, slot_path, value, cons_parts,
+                            missing_ok_leaf)
+        elif isinstance(value, list):
+            raise CompileError('nested arrays not vectorized')
+        else:
+            cons_parts.append(_compile_leaf(cps, slot_path, value,
+                                            missing_ok=missing_ok_leaf))
+    if not cons_parts and not cond_parts:
+        raise CompileError('empty element pattern')
+    condition = BoolExpr.all(cond_parts) if cond_parts else None
+    constraint = BoolExpr.all(cons_parts) if cons_parts else \
+        BoolExpr.of(Leaf(Slot(array_path + ('*',)), 'true'))
+    if mode == 'exists':
+        return ElementBlock(array_path=array_path, condition=None,
+                            constraint=BoolExpr.all(cond_parts + cons_parts))
+    return ElementBlock(array_path=array_path, condition=condition,
+                        constraint=constraint)
+
+
+def _flatten_nested(cps: CompiledPolicySet, base_path: Tuple[str, ...],
+                    pattern: dict, out: List[BoolExpr],
+                    missing_ok: bool) -> None:
+    """Flatten nested scalar maps under an element, e.g.
+    containers[].securityContext.privileged."""
+    for key, value in pattern.items():
+        a = anchor_mod.parse(key)
+        bare = a.key if a else key
+        if a is not None and anchor_mod.is_negation(a):
+            out.append(BoolExpr.of(Leaf(Slot(base_path + (bare,)), 'absent')))
+            continue
+        if a is not None and not anchor_mod.is_equality(a):
+            raise CompileError('nested anchors not vectorized')
+        leaf_missing_ok = missing_ok or (
+            a is not None and anchor_mod.is_equality(a))
+        if isinstance(value, dict):
+            _flatten_nested(cps, base_path + (bare,), value, out,
+                            leaf_missing_ok)
+        elif isinstance(value, list):
+            raise CompileError('nested arrays not vectorized')
+        else:
+            out.append(_compile_leaf(cps, base_path + (bare,), value,
+                                     missing_ok=leaf_missing_ok))
+
+
+# ---------------------------------------------------------------------------
+# Leaf compilation
+
+def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...], pattern: Any,
+                  missing_ok: bool) -> BoolExpr:
+    slot = Slot(path)
+    if slot.elem and path.count('*') > 1:
+        raise CompileError('nested element dimensions not vectorized')
+    cps.slot_id(slot)
+
+    def L(op, operand=None):
+        return BoolExpr.of(Leaf(slot, op, operand, missing_ok))
+
+    if isinstance(pattern, bool):
+        return L('eq_bool', pattern)
+    if pattern is None:
+        return L('eq_null')
+    if isinstance(pattern, int):
+        return L('eq_int', pattern)
+    if isinstance(pattern, float):
+        milli = Fraction(str(pattern)) * 1000
+        if milli.denominator != 1:
+            raise CompileError('sub-milli float pattern not exact on device')
+        return L('eq_float', pattern)
+    if isinstance(pattern, dict):
+        raise CompileError('map leaf')
+    if isinstance(pattern, str):
+        return _compile_string_pattern(slot, pattern, missing_ok)
+    raise CompileError(f'unsupported leaf type {type(pattern).__name__}')
+
+
+def _compile_string_pattern(slot: Slot, pattern: str,
+                            missing_ok: bool) -> BoolExpr:
+    """Compile the string operator grammar
+    (reference: pkg/engine/pattern/pattern.go:152 validateStringPatterns)."""
+    if pattern == '*':
+        return BoolExpr.of(Leaf(slot, 'star', None, missing_ok))
+    ors = []
+    # exact equality short-circuit (value == pattern) is subsumed by terms
+    for condition in pattern.split('|'):
+        ands = []
+        for term in condition.strip(' ').split('&'):
+            ands.append(_compile_string_term(slot, term.strip(' '),
+                                             missing_ok))
+        ors.append(BoolExpr.all(ands))
+    return BoolExpr.any(ors)
+
+
+def _compile_string_term(slot: Slot, term: str, missing_ok: bool) -> BoolExpr:
+    op = leaf_pattern.get_operator_from_string_pattern(term)
+    if op == leaf_pattern.OP_IN_RANGE:
+        m = leaf_pattern.IN_RANGE_RE.match(term)
+        return BoolExpr.all([
+            _compile_string_term(slot, f'>= {m.group(1)}', missing_ok),
+            _compile_string_term(slot, f'<= {m.group(2)}', missing_ok)])
+    if op == leaf_pattern.OP_NOT_IN_RANGE:
+        m = leaf_pattern.NOT_IN_RANGE_RE.match(term)
+        return BoolExpr.any([
+            _compile_string_term(slot, f'< {m.group(1)}', missing_ok),
+            _compile_string_term(slot, f'> {m.group(2)}', missing_ok)])
+    operand = term[len(op):].strip(' ')
+    cmp = _CMP_OF_OP[op]
+
+    def L(lop, loperand=None):
+        return BoolExpr.of(Leaf(slot, lop, loperand, missing_ok))
+
+    alternatives: List[BoolExpr] = []
+    # 1. duration comparison (only if operand parses as Go duration)
+    try:
+        nanos = parse_duration(operand)
+        alternatives.append(L('cmp_dur', (cmp, nanos)))
+    except (ValueError, TypeError):
+        pass
+    # 2. quantity comparison (only if operand parses as k8s quantity)
+    try:
+        q = Quantity.parse(operand)
+        milli = q.value * 1000
+        if milli.denominator != 1:
+            raise CompileError('sub-milli quantity operand')
+        alternatives.append(L('cmp_qty', (cmp, int(milli))))
+    except ValueError:
+        pass
+    # 3. wildcard string comparison (only for == / !=)
+    if cmp in (CMP_EQ, CMP_NE):
+        str_check = _compile_wildcard_eq(slot, operand, missing_ok)
+        if cmp == CMP_NE:
+            str_check = BoolExpr.negate(str_check)
+            # NotEqual with missing key still fails the walk: negation of a
+            # missing-fails leaf would wrongly pass — force explicit handling
+            str_check = BoolExpr.all([
+                BoolExpr.of(Leaf(slot, 'convertible', None, missing_ok)),
+                str_check])
+        alternatives.append(str_check)
+    if not alternatives:
+        raise CompileError(f'no vectorizable interpretation for {term!r}')
+    return BoolExpr.any(alternatives)
+
+
+def _compile_wildcard_eq(slot: Slot, operand: str,
+                         missing_ok: bool) -> BoolExpr:
+    """Classify a wildcard pattern into a vectorizable string class."""
+    def L(op, loperand=None):
+        return BoolExpr.of(Leaf(slot, op, loperand, missing_ok))
+
+    if len(operand.encode()) > STR_LEN:
+        raise CompileError('operand longer than encoded string window')
+    has_star = '*' in operand
+    has_q = '?' in operand
+    if not has_star and not has_q:
+        return L('eq_str', operand)
+    if operand == '*':
+        return L('any_str')
+    if operand == '?*':
+        return L('nonempty')
+    if has_q:
+        raise CompileError(f'general ? wildcard not vectorized: {operand!r}')
+    parts = operand.split('*')
+    if len(parts) == 2 and parts[0] and not parts[1]:
+        return L('prefix', parts[0])
+    if len(parts) == 2 and not parts[0] and parts[1]:
+        if len(parts[1].encode()) > 16:
+            raise CompileError('suffix longer than tail window')
+        return L('suffix', parts[1])
+    if len(parts) == 3 and parts[0] and parts[2] and not parts[1]:
+        # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
+        if len(parts[2].encode()) > 16:
+            raise CompileError('suffix longer than tail window')
+        return BoolExpr.all([
+            L('prefix', parts[0]), L('suffix', parts[2]),
+            L('min_len', len(parts[0].encode()) + len(parts[2].encode()))])
+    raise CompileError(f'wildcard class not vectorized: {operand!r}')

@@ -1,0 +1,284 @@
+"""Batch encoder: resources → fixed-shape slot tensors.
+
+Projects each resource onto the compiled slot table (the document never
+reaches the device). Encoding is conservative toward FAIL: any value the
+encoder cannot represent exactly gets invalid flags, which can only turn a
+device PASS into a device non-pass — and all non-pass verdicts are
+re-materialized by the host engine, so correctness is preserved.
+
+Channels per slot (scalar slots shape [R], element slots [R, E]):
+  tag        i8   type tag (ir.TAG_*)
+  milli      i64  numeric value ×1000 (ints exact; quantities; null → 0)
+  milli_ok   bool
+  nanos      i64  Go duration in ns (strings with units; null → 0)
+  nanos_ok   bool
+  str_is_int / str_is_float  bool  (string parse classes)
+  str_len    i32  byte length of the value's Go string form
+  str_head   u8[STR_LEN]  first bytes
+  str_tail   u8[TAIL_LEN] last bytes, right-aligned
+Arrays referenced by element blocks additionally get:
+  arr_tag    i8   tag of the array node itself
+  elem_count i32
+"""
+
+from __future__ import annotations
+
+import math
+from fractions import Fraction
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..utils.duration import parse_duration
+from ..utils.quantity import Quantity
+from .ir import (MAX_ELEMS, STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT, TAG_INT,
+                 TAG_MAP, TAG_MISSING, TAG_NULL, TAG_STRING,
+                 CompiledPolicySet, Slot)
+
+TAIL_LEN = 16
+
+_INT64_MAX = (1 << 63) - 1
+
+
+def _go_float_str(v: float) -> str:
+    from ..engine.pattern import _go_format_float_e
+    return _go_format_float_e(v)
+
+
+class SlotArrays:
+    """numpy arrays for one slot."""
+
+    def __init__(self, n: int, elem: bool):
+        shape = (n, MAX_ELEMS) if elem else (n,)
+        self.tag = np.zeros(shape, np.int8)
+        self.milli = np.zeros(shape, np.int64)
+        self.milli_ok = np.zeros(shape, bool)
+        self.nanos = np.zeros(shape, np.int64)
+        self.nanos_ok = np.zeros(shape, bool)
+        self.str_is_int = np.zeros(shape, bool)
+        self.str_is_float = np.zeros(shape, bool)
+        self.str_len = np.zeros(shape, np.int32)
+        self.str_head = np.zeros(shape + (STR_LEN,), np.uint8)
+        self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8)
+
+    def tensors(self) -> Dict[str, np.ndarray]:
+        return {k: getattr(self, k) for k in (
+            'tag', 'milli', 'milli_ok', 'nanos', 'nanos_ok', 'str_is_int',
+            'str_is_float', 'str_len', 'str_head', 'str_tail')}
+
+
+class Batch:
+    def __init__(self, n: int):
+        self.n = n
+        self.slots: Dict[Slot, SlotArrays] = {}
+        self.arrays: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
+
+    def tensors(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for i, (slot, arrs) in enumerate(self.slots.items()):
+            for k, v in arrs.tensors().items():
+                out[f's{i}_{k}'] = v
+        for j, (path, d) in enumerate(self.arrays.items()):
+            out[f'a{j}_tag'] = d['arr_tag']
+            out[f'a{j}_count'] = d['elem_count']
+        return out
+
+
+def _walk(doc: Any, path: Tuple[str, ...]):
+    """Resolve a structural path; yields the value or a marker."""
+    cur = doc
+    for key in path:
+        if key == '*':
+            return cur  # caller handles element expansion
+        if isinstance(cur, dict):
+            if key not in cur:
+                return _MISSING
+            cur = cur[key]
+        else:
+            return _MISSING
+    return cur
+
+
+_MISSING = object()
+
+
+_ALL_NEEDS = (True, True, True)
+
+
+def _encode_value(arrs: SlotArrays, idx, value: Any,
+                  need=_ALL_NEEDS) -> None:
+    t = arrs
+    need_str, need_milli, need_nanos = need
+    if value is _MISSING:
+        t.tag[idx] = TAG_MISSING
+        return
+    if value is None:
+        t.tag[idx] = TAG_NULL
+        t.milli_ok[idx] = True
+        t.nanos_ok[idx] = True
+        return
+    if isinstance(value, bool):
+        t.tag[idx] = TAG_BOOL
+        t.milli[idx] = 1000 if value else 0
+        t.milli_ok[idx] = True
+        if need_str:
+            _encode_str(t, idx, 'true' if value else 'false')
+        return
+    if isinstance(value, int):
+        t.tag[idx] = TAG_INT
+        if abs(value) <= _INT64_MAX // 1000:
+            t.milli[idx] = value * 1000
+            t.milli_ok[idx] = True
+        if need_str:
+            _encode_str(t, idx, str(value))
+        return
+    if isinstance(value, float):
+        t.tag[idx] = TAG_FLOAT
+        if need_milli and math.isfinite(value):
+            frac = Fraction(str(value)) * 1000
+            if frac.denominator == 1 and abs(frac.numerator) <= _INT64_MAX:
+                t.milli[idx] = int(frac)
+                t.milli_ok[idx] = True
+        if need_str:
+            _encode_str(t, idx, _go_float_str(value))
+        return
+    if isinstance(value, str):
+        t.tag[idx] = TAG_STRING
+        if need_str:
+            _encode_str(t, idx, value)
+            s = value
+            try:
+                int(s, 10)
+                t.str_is_int[idx] = True
+                t.str_is_float[idx] = True
+            except ValueError:
+                try:
+                    float(s)
+                    t.str_is_float[idx] = True
+                except ValueError:
+                    pass
+        if need_milli:
+            try:
+                q = Quantity.parse(value)
+                m = q.value * 1000
+                if m.denominator == 1 and abs(m.numerator) <= _INT64_MAX:
+                    t.milli[idx] = int(m)
+                    t.milli_ok[idx] = True
+            except ValueError:
+                pass
+        if need_nanos:
+            try:
+                t.nanos[idx] = parse_duration(value)
+                t.nanos_ok[idx] = True
+            except ValueError:
+                pass
+        return
+    if isinstance(value, dict):
+        t.tag[idx] = TAG_MAP
+        return
+    if isinstance(value, list):
+        t.tag[idx] = TAG_ARRAY
+        return
+    t.tag[idx] = TAG_MISSING
+
+
+def _encode_str(t: SlotArrays, idx, s: str) -> None:
+    b = s.encode('utf-8')
+    t.str_len[idx] = len(b)
+    head = b[:STR_LEN]
+    t.str_head[idx][:len(head)] = np.frombuffer(head, np.uint8)
+    tail = b[-TAIL_LEN:]
+    # right-aligned tail
+    t.str_tail[idx][TAIL_LEN - len(tail):] = np.frombuffer(tail, np.uint8)
+
+
+_STR_OPS = {'eq_str', 'prefix', 'suffix', 'min_len', 'nonempty', 'any_str',
+            'convertible', 'eq_int', 'eq_float'}
+_MILLI_OPS = {'eq_bool', 'eq_null', 'eq_int', 'eq_float', 'cmp_qty'}
+_NANOS_OPS = {'cmp_dur'}
+
+
+def _slot_needs(cps: CompiledPolicySet) -> Dict[Slot, Tuple[bool, bool, bool]]:
+    """Which channels each slot actually requires (str, milli, nanos)."""
+    cached = getattr(cps, '_slot_needs_cache', None)
+    if cached is not None:
+        return cached
+    needs: Dict[Slot, List[bool]] = {s: [False, False, False]
+                                     for s in cps.slots}
+
+    def visit(expr):
+        if expr is None:
+            return
+        if expr.kind == 'leaf':
+            leaf = expr.leaf
+            n = needs.setdefault(leaf.slot, [False, False, False])
+            if leaf.op in _STR_OPS:
+                n[0] = True
+            if leaf.op in _MILLI_OPS:
+                n[1] = True
+            if leaf.op in _NANOS_OPS:
+                n[2] = True
+        for c in expr.children:
+            visit(c)
+
+    for prog in cps.programs:
+        visit(prog.scalar)
+        visit(prog.scalar_condition)
+        for block in prog.elements:
+            visit(block.condition)
+            visit(block.constraint)
+    out = {s: tuple(v) for s, v in needs.items()}
+    cps._slot_needs_cache = out
+    return out
+
+
+def encode_batch(resources: List[dict], cps: CompiledPolicySet,
+                 padded_n: int = 0) -> Batch:
+    n = max(len(resources), padded_n)
+    batch = Batch(n)
+    needs = _slot_needs(cps)
+    # collect array paths used by element blocks
+    array_paths = set()
+    for prog in cps.programs:
+        for block in prog.elements:
+            array_paths.add(block.array_path)
+    for path in array_paths:
+        batch.arrays[path] = {
+            'arr_tag': np.zeros(n, np.int8),
+            'elem_count': np.zeros(n, np.int32),
+        }
+    for slot in cps.slots:
+        batch.slots[slot] = SlotArrays(n, slot.elem)
+
+    slot_plan = [(slot, arrs, needs.get(slot, (True, True, True)))
+                 for slot, arrs in batch.slots.items()]
+    for r, doc in enumerate(resources):
+        for path, arrs in batch.arrays.items():
+            value = _walk(doc, path)
+            if value is _MISSING:
+                arrs['arr_tag'][r] = TAG_MISSING
+            elif isinstance(value, list):
+                arrs['arr_tag'][r] = TAG_ARRAY
+                arrs['elem_count'][r] = min(len(value), MAX_ELEMS)
+                if len(value) > MAX_ELEMS:
+                    # overflow: force host fallback by marking invalid
+                    arrs['arr_tag'][r] = TAG_MAP
+            else:
+                arrs['arr_tag'][r] = TAG_MAP  # wrong type → device FAIL
+        for slot, arrs, need in slot_plan:
+            if not slot.elem:
+                _encode_value(arrs, r, _walk(doc, slot.path), need)
+                continue
+            star = slot.path.index('*')
+            container = _walk(doc, slot.path[:star])
+            rest = slot.path[star + 1:]
+            if not isinstance(container, list):
+                continue  # stays MISSING; block-level arr_tag handles it
+            for e, elem in enumerate(container[:MAX_ELEMS]):
+                if rest:
+                    value = _walk(elem, rest) if isinstance(elem, dict) \
+                        else _MISSING
+                else:
+                    value = elem
+                _encode_value(arrs, (r, e), value, need)
+    return batch

@@ -1,0 +1,177 @@
+"""Compiler IR: policies → slot table + vectorized check programs.
+
+The TPU execution model replaces the reference's per-resource tree-walk
+interpreter (reference: pkg/engine/validate/validate.go) with trace-time
+specialization:
+
+* a **slot** is a policy-relevant structural path (e.g.
+  ``spec.containers.*.image``); resources are *projected* onto the slot
+  table at encode time — the document itself never reaches the device
+* a **leaf check** is a scalar predicate on one slot, chosen from a closed
+  vectorizable vocabulary (string classes, numeric/quantity/duration
+  comparisons, existence, bool/null equality)
+* a **rule program** is a small boolean tree over leaf checks with
+  tri-state (pass/fail/skip) element semantics mirroring the anchor rules
+* anything outside the vocabulary is compiled to HOST_FALLBACK and runs on
+  the host engine; the device result for such rules is ignored
+
+Because programs are Python constants closed over by the jitted evaluator,
+XLA sees straight-line fused elementwise ops over ``[R, E]`` tensors — no
+interpreter loop on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# type tags in the encoded tensors
+TAG_MISSING = 0
+TAG_NULL = 1
+TAG_BOOL = 2
+TAG_INT = 3
+TAG_FLOAT = 4
+TAG_STRING = 5
+TAG_MAP = 6
+TAG_ARRAY = 7
+
+# maximum string bytes kept per value (suffix-matched strings keep the tail)
+STR_LEN = 64
+# maximum array elements encoded per element-bearing slot
+MAX_ELEMS = 16
+
+
+@dataclass(frozen=True)
+class Slot:
+    """A policy-relevant structural path.
+
+    ``path`` is a tuple of keys; ``'*'`` marks an array-of-maps traversal.
+    At most one ``'*'`` is supported in the vectorized path (deeper nesting
+    falls back to host). ``elem`` is True when the slot has an element
+    dimension.
+    """
+    path: Tuple[str, ...]
+
+    @property
+    def elem(self) -> bool:
+        return '*' in self.path
+
+    def __str__(self):
+        return '.'.join(self.path)
+
+
+# Leaf check ops
+OP_EXISTS = 'exists'            # "?*": non-empty scalar
+OP_STAR = 'star'                # "*": key present and non-null
+OP_EQ_STR = 'eq_str'
+OP_NE_STR = 'ne_str'
+OP_PREFIX = 'prefix'
+OP_NOT_PREFIX = 'not_prefix'
+OP_SUFFIX = 'suffix'
+OP_NOT_SUFFIX = 'not_suffix'
+OP_CONTAINS = 'contains'
+OP_NOT_CONTAINS = 'not_contains'
+OP_CMP_NUM = 'cmp_num'          # operand: (cmp, float)
+OP_CMP_QTY = 'cmp_qty'          # operand: (cmp, milli int)
+OP_CMP_DUR = 'cmp_dur'          # operand: (cmp, nanos int)
+OP_EQ_BOOL = 'eq_bool'
+OP_EQ_NULL = 'eq_null'
+OP_EQ_NUM = 'eq_num'
+OP_TRUE = 'true'
+
+CMP_GT, CMP_GE, CMP_LT, CMP_LE, CMP_EQ, CMP_NE = '>', '>=', '<', '<=', '==', '!='
+
+
+@dataclass(frozen=True)
+class Leaf:
+    """A scalar predicate on a slot."""
+    slot: Slot
+    op: str
+    operand: Any = None
+    # missing key fails the check unless the leaf is under an equality
+    # anchor (=(key): missing passes) — the compiler folds that in here
+    missing_ok: bool = False
+
+
+@dataclass(frozen=True)
+class BoolExpr:
+    """AND/OR/NOT tree over leaves (within one element scope)."""
+    kind: str                      # 'leaf' | 'and' | 'or' | 'not'
+    leaf: Optional[Leaf] = None
+    children: Tuple['BoolExpr', ...] = ()
+
+    @staticmethod
+    def of(leaf: Leaf) -> 'BoolExpr':
+        return BoolExpr('leaf', leaf=leaf)
+
+    @staticmethod
+    def all(children: List['BoolExpr']) -> 'BoolExpr':
+        if len(children) == 1:
+            return children[0]
+        return BoolExpr('and', children=tuple(children))
+
+    @staticmethod
+    def any(children: List['BoolExpr']) -> 'BoolExpr':
+        if len(children) == 1:
+            return children[0]
+        return BoolExpr('or', children=tuple(children))
+
+    @staticmethod
+    def negate(child: 'BoolExpr') -> 'BoolExpr':
+        return BoolExpr('not', children=(child,))
+
+
+@dataclass(frozen=True)
+class ElementBlock:
+    """Per-element tri-state semantics for one array-of-maps pattern
+    (reference: pkg/engine/validate/validate.go:218 validateArrayOfMaps).
+
+    For each element: if ``condition`` (conditional anchors) fails →
+    element SKIP; else ``constraint`` must hold → else FAIL.
+    Rule-level: any FAIL → fail; no FAIL and applyCount==0 with skips → skip.
+    """
+    array_path: Tuple[str, ...]
+    condition: Optional[BoolExpr]   # None = unconditional
+    constraint: BoolExpr
+
+
+@dataclass(frozen=True, eq=False)
+class RuleProgram:
+    """One compiled rule."""
+    policy_name: str
+    rule_name: str
+    policy_index: int
+    rule_index: int
+    # scalar (non-element) constraints, all must hold
+    scalar: Optional[BoolExpr]
+    # map-level conditional anchors: all must hold else rule SKIP
+    scalar_condition: Optional[BoolExpr]
+    # element blocks (array-of-maps), each contributes tri-state
+    elements: Tuple[ElementBlock, ...]
+    # static pass message (compile-time constant)
+    pass_message: str
+    background: bool = True
+    # the original rule dict (for host-side match evaluation)
+    rule_raw: Optional[dict] = None
+
+
+@dataclass
+class CompiledPolicySet:
+    """Output of the compiler for a policy set."""
+    slots: List[Slot] = field(default_factory=list)
+    slot_index: Dict[Slot, int] = field(default_factory=dict)
+    programs: List[RuleProgram] = field(default_factory=list)
+    # (policy_index, rule dict, policy) for rules the device cannot evaluate
+    host_rules: List[Tuple[int, dict, Any]] = field(default_factory=list)
+    # per-policy kind → rule match precomputation inputs
+    policies: List[Any] = field(default_factory=list)
+
+    def slot_id(self, slot: Slot) -> int:
+        if slot not in self.slot_index:
+            self.slot_index[slot] = len(self.slots)
+            self.slots.append(slot)
+        return self.slot_index[slot]
+
+
+class CompileError(Exception):
+    """Raised when a rule (or part) cannot be vectorized → host fallback."""

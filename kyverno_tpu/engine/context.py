@@ -35,9 +35,13 @@ class VariableNotFoundError(ContextError):
 
 def merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch (reference merges via
-    jsonpatch.MergeMergePatches, pkg/engine/context/context.go:123)."""
+    jsonpatch.MergeMergePatches, pkg/engine/context/context.go:123).
+
+    Non-dict patch values are shared by reference, not copied: the engine
+    treats context documents as immutable (queries only read; substitution
+    builds new objects), which also makes checkpoints O(1)."""
     if not isinstance(patch, dict):
-        return copy.deepcopy(patch)
+        return patch
     if not isinstance(target, dict):
         target = {}
     else:
@@ -126,9 +130,14 @@ class Context:
         self.add_json({'images': images})
 
     # -- checkpoint stack ----------------------------------------------------
+    # O(1) snapshots: every mutation goes through add_json → merge_patch,
+    # which is copy-on-write (builds new dicts along patched paths, never
+    # mutates in place), so a checkpoint is just a reference
+    # (the reference deep-copies raw bytes instead,
+    # pkg/engine/context/context.go:301)
 
     def checkpoint(self) -> None:
-        self._checkpoints.append(copy.deepcopy(self._data))
+        self._checkpoints.append(self._data)
 
     def restore(self) -> None:
         if self._checkpoints:
@@ -136,7 +145,7 @@ class Context:
 
     def reset(self) -> None:
         if self._checkpoints:
-            self._data = copy.deepcopy(self._checkpoints[-1])
+            self._data = self._checkpoints[-1]
 
     # -- querying ------------------------------------------------------------
 

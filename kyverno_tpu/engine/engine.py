@@ -129,6 +129,19 @@ class Engine:
             from ..pss.evaluate import evaluate_pod_security
             pss_evaluator = evaluate_pod_security
         self.pss_evaluator = pss_evaluator
+        # autogen expansion memo: policies are immutable during evaluation
+        self._rules_cache: Dict[int, List[dict]] = {}
+
+    def _compute_rules(self, policy: Policy) -> List[dict]:
+        # the cache entry holds a strong reference to the keyed dict so the
+        # id cannot be recycled; identity is re-verified on every hit
+        key = id(policy.raw)
+        entry = self._rules_cache.get(key)
+        if entry is not None and entry[0] is policy.raw:
+            return entry[1]
+        rules = compute_rules(policy)
+        self._rules_cache[key] = (policy.raw, rules)
+        return rules
 
     # -- public entry points -------------------------------------------------
 
@@ -181,7 +194,7 @@ class Engine:
         resp = EngineResponse(pctx.policy)
         pctx.json_context.checkpoint()
         try:
-            rules = compute_rules(pctx.policy)
+            rules = self._compute_rules(pctx.policy)
             apply_rules = pctx.policy.apply_rules
             policy = pctx.policy
 
@@ -308,7 +321,9 @@ class Validator:
                  foreach_entry: Optional[dict] = None, nesting: int = 0):
         self.engine = engine
         self.pctx = pctx
-        self.rule = rule.copy()
+        # no deep copy: the rule dict is never mutated (substitution builds
+        # new objects; self.pattern is rebound, not written through)
+        self.rule = rule
         self.nesting = nesting
         if foreach_entry is None:
             v = self.rule.validation

@@ -95,7 +95,7 @@ class ExprRef:
         self.interpreter = interpreter
 
     def visit(self, value: Any) -> Any:
-        return self.interpreter.visit(self.node, value)
+        return _defined(self.interpreter.visit(self.node, value))
 
 
 class FunctionRegistry:

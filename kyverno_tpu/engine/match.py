@@ -71,7 +71,7 @@ def matches_resource_description(resource: Resource, rule, admission_info: Optio
             if not exclude_filter(f):
                 reasons.append('resource excluded since one of the criteria excluded it')
     elif ex_all:
-        if ex_all and all(not exclude_filter(f) for f in ex_all):
+        if all(not exclude_filter(f) for f in ex_all):
             reasons.append('resource excluded since the combination of all criteria exclude it')
     elif exclude:
         f = {'resources': exclude.get('resources') or {},

@@ -12,7 +12,6 @@ from typing import Any, List, Optional
 
 from ...api.policy import Policy, Rule
 from ...api.unstructured import Resource
-from ...autogen.autogen import compute_rules
 from .. import operators
 from .. import variables as vars_mod
 from ..api import (EngineResponse, PolicyContext, RuleResponse, RuleStatus,
@@ -135,7 +134,7 @@ def mutate(engine, pctx: PolicyContext) -> EngineResponse:
     pctx.json_context.checkpoint()
     try:
         apply_rules = policy.apply_rules
-        for raw_rule in compute_rules(policy):
+        for raw_rule in engine._compute_rules(policy):
             rule = Rule(raw_rule)
             if not rule.has_mutate():
                 continue

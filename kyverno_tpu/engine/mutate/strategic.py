@@ -164,7 +164,7 @@ def _validate_conditions(pattern: dict, resource: Any) -> None:
             if isinstance(pattern_value, dict):
                 processed = _handle_add_if_not_present(pattern_value,
                                                        resource_value)
-                if len(processed) != len(pattern_value) or processed != pattern_value:
+                if processed != pattern_value:
                     pattern[key] = processed
                     continue
                 had_add = any(anchor.is_add_if_not_present(anchor.parse(k))

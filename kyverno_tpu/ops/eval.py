@@ -1,0 +1,258 @@
+"""Batched rule evaluation on device.
+
+``build_evaluator(cps)`` returns a jitted function mapping the encoded batch
+tensors to a status matrix ``[R, P]`` (0=pass, 1=fail, 2=skip) for the
+compiled programs. The program structure is baked in at trace time, so XLA
+sees straight-line fused elementwise ops over ``[R]`` / ``[R, E]`` tensors —
+the policy set is *compiled*, not interpreted.
+
+Sharding: the batch axis is data-parallel; ``shard_batch`` places tensors on
+a 1-D mesh so the same jitted function scales across chips via pjit/GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+
+# i64 lanes are required: quantity milli-values span past 2^31 (e.g. 4Gi
+# milli ≈ 4.3e12). TPU lowers s64 compares to paired s32 ops; throughput
+# impact is negligible for elementwise predicates.
+jax.config.update('jax_enable_x64', True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from ..compiler.encode import TAIL_LEN, Batch
+from ..compiler.ir import (MAX_ELEMS, STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT,
+                           TAG_INT, TAG_MISSING, TAG_NULL, TAG_STRING,
+                           BoolExpr, CompiledPolicySet, ElementBlock, Leaf,
+                           RuleProgram)
+
+STATUS_PASS, STATUS_FAIL, STATUS_SKIP = 0, 1, 2
+
+_CONVERTIBLE_TAGS = (TAG_STRING, TAG_INT, TAG_FLOAT, TAG_BOOL)
+
+
+def _str_const(s: str, length: int) -> np.ndarray:
+    b = s.encode('utf-8')[:length]
+    out = np.zeros(length, np.uint8)
+    out[:len(b)] = np.frombuffer(b, np.uint8)
+    return out
+
+
+def _tail_const(s: str) -> np.ndarray:
+    b = s.encode('utf-8')[-TAIL_LEN:]
+    out = np.zeros(TAIL_LEN, np.uint8)
+    out[TAIL_LEN - len(b):] = np.frombuffer(b, np.uint8)
+    return out
+
+
+class _SlotRef:
+    """Names of the tensors for one slot inside the flat batch dict."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+
+    def __getattr__(self, name):
+        return f'{self.prefix}_{name}'
+
+
+def build_evaluator(cps: CompiledPolicySet):
+    slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
+    array_prefix = {}
+    array_paths = []
+    for prog in cps.programs:
+        for block in prog.elements:
+            if block.array_path not in array_prefix:
+                array_prefix[block.array_path] = f'a{len(array_paths)}'
+                array_paths.append(block.array_path)
+
+    def leaf_eval(t: Dict[str, jnp.ndarray], leaf: Leaf) -> jnp.ndarray:
+        p = slot_prefix[leaf.slot]
+        tag = t[f'{p}_tag']
+        op = leaf.op
+
+        def is_tag(*tags):
+            r = tag == tags[0]
+            for x in tags[1:]:
+                r = r | (tag == x)
+            return r
+
+        convertible = is_tag(*_CONVERTIBLE_TAGS)
+        if op == 'true':
+            result = jnp.ones_like(tag, dtype=bool)
+        elif op == 'absent':
+            return tag == TAG_MISSING  # missing_ok does not apply
+        elif op == 'star':
+            return ~is_tag(TAG_MISSING, TAG_NULL)
+        elif op == 'any_str':
+            result = convertible
+        elif op == 'nonempty':
+            result = (is_tag(TAG_INT, TAG_FLOAT, TAG_BOOL) |
+                      ((tag == TAG_STRING) & (t[f'{p}_str_len'] > 0)))
+        elif op == 'convertible':
+            result = convertible
+        elif op == 'eq_bool':
+            result = (tag == TAG_BOOL) & (
+                (t[f'{p}_milli'] != 0) == bool(leaf.operand))
+        elif op == 'eq_null':
+            result = ((tag == TAG_NULL) |
+                      (is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT) &
+                       t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == 0)) |
+                      ((tag == TAG_STRING) & (t[f'{p}_str_len'] == 0)))
+        elif op == 'eq_int':
+            target = int(leaf.operand) * 1000
+            ok = t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == target)
+            result = ok & (is_tag(TAG_INT, TAG_FLOAT) |
+                           ((tag == TAG_STRING) & t[f'{p}_str_is_int']))
+        elif op == 'eq_float':
+            from fractions import Fraction
+            target = int(Fraction(str(leaf.operand)) * 1000)
+            ok = t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == target)
+            result = ok & (is_tag(TAG_INT, TAG_FLOAT) |
+                           ((tag == TAG_STRING) & t[f'{p}_str_is_float']))
+        elif op == 'cmp_qty':
+            # compareDuration/Quantity/String are a plain OR chain in the
+            # reference, so quantity validity is just "parses as quantity"
+            # (milli_ok covers that for strings)
+            cmp, operand = leaf.operand
+            valid = t[f'{p}_milli_ok'] & is_tag(TAG_INT, TAG_FLOAT, TAG_NULL,
+                                                TAG_STRING)
+            result = valid & _cmp(t[f'{p}_milli'], operand, cmp)
+        elif op == 'cmp_dur':
+            cmp, operand = leaf.operand
+            valid = t[f'{p}_nanos_ok'] & is_tag(TAG_STRING, TAG_NULL)
+            result = valid & _cmp(t[f'{p}_nanos'], operand, cmp)
+        elif op == 'eq_str':
+            const = _str_const(leaf.operand, STR_LEN)
+            blen = len(leaf.operand.encode('utf-8'))
+            result = (convertible & (t[f'{p}_str_len'] == blen) &
+                      jnp.all(t[f'{p}_str_head'] == const, axis=-1))
+        elif op == 'prefix':
+            b = leaf.operand.encode('utf-8')
+            const = np.frombuffer(b, np.uint8)
+            head = t[f'{p}_str_head'][..., :len(b)]
+            result = (convertible & (t[f'{p}_str_len'] >= len(b)) &
+                      jnp.all(head == const, axis=-1))
+        elif op == 'suffix':
+            b = leaf.operand.encode('utf-8')
+            const = np.frombuffer(b, np.uint8)
+            tail = t[f'{p}_str_tail'][..., TAIL_LEN - len(b):]
+            result = (convertible & (t[f'{p}_str_len'] >= len(b)) &
+                      jnp.all(tail == const, axis=-1))
+        elif op == 'min_len':
+            result = convertible & (t[f'{p}_str_len'] >= int(leaf.operand))
+        else:
+            raise ValueError(f'unknown leaf op {op!r}')
+
+        if leaf.missing_ok:
+            return result | (tag == TAG_MISSING)
+        return result
+
+    def expr_eval(t, expr: BoolExpr) -> jnp.ndarray:
+        if expr.kind == 'leaf':
+            return leaf_eval(t, expr.leaf)
+        if expr.kind == 'and':
+            out = expr_eval(t, expr.children[0])
+            for c in expr.children[1:]:
+                out = out & expr_eval(t, c)
+            return out
+        if expr.kind == 'or':
+            out = expr_eval(t, expr.children[0])
+            for c in expr.children[1:]:
+                out = out | expr_eval(t, c)
+            return out
+        if expr.kind == 'not':
+            return ~expr_eval(t, expr.children[0])
+        raise ValueError(expr.kind)
+
+    def block_status(t, block: ElementBlock) -> jnp.ndarray:
+        """Tri-state per resource for one element block."""
+        ap = array_prefix[block.array_path]
+        arr_tag = t[f'{ap}_tag']
+        count = t[f'{ap}_count']
+        valid = jnp.arange(MAX_ELEMS)[None, :] < count[:, None]
+        cons = expr_eval(t, block.constraint)
+        if cons.ndim == 1:  # constraint referenced no element slot
+            cons = jnp.broadcast_to(cons[:, None], valid.shape)
+        if block.condition is not None:
+            cond = expr_eval(t, block.condition)
+            if cond.ndim == 1:
+                cond = jnp.broadcast_to(cond[:, None], valid.shape)
+        else:
+            cond = jnp.ones_like(valid)
+        fail_e = valid & cond & ~cons
+        skip_e = valid & ~cond
+        pass_e = valid & cond & cons
+        any_fail = jnp.any(fail_e, axis=1)
+        any_pass = jnp.any(pass_e, axis=1)
+        any_skip = jnp.any(skip_e, axis=1)
+        # array itself missing or not a list → structural failure
+        bad_array = arr_tag != TAG_ARRAY
+        status = jnp.where(
+            bad_array | any_fail, STATUS_FAIL,
+            jnp.where(~any_pass & any_skip, STATUS_SKIP, STATUS_PASS))
+        return status.astype(jnp.int8)
+
+    def program_status(t, prog: RuleProgram) -> jnp.ndarray:
+        n = t[next(iter(t))].shape[0]
+        units: List[jnp.ndarray] = []
+        if prog.scalar_condition is not None:
+            cond_ok = expr_eval(t, prog.scalar_condition)
+            units.append(jnp.where(cond_ok, STATUS_PASS,
+                                   STATUS_SKIP).astype(jnp.int8))
+        if prog.scalar is not None:
+            ok = expr_eval(t, prog.scalar)
+            units.append(jnp.where(ok, STATUS_PASS,
+                                   STATUS_FAIL).astype(jnp.int8))
+        for block in prog.elements:
+            units.append(block_status(t, block))
+        if not units:
+            return jnp.zeros(n, jnp.int8)
+        # first non-pass unit in order decides (mirrors the walk's
+        # first-error-wins semantics)
+        status = units[0]
+        for u in units[1:]:
+            status = jnp.where(status == STATUS_PASS, u, status)
+        return status
+
+    def evaluate(t: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        cols = [program_status(t, prog) for prog in cps.programs]
+        if not cols:
+            n = t[next(iter(t))].shape[0] if t else 0
+            return jnp.zeros((n, 0), jnp.int8)
+        return jnp.stack(cols, axis=1)
+
+    return jax.jit(evaluate)
+
+
+def _cmp(value, operand, cmp):
+    if cmp == '>':
+        return value > operand
+    if cmp == '>=':
+        return value >= operand
+    if cmp == '<':
+        return value < operand
+    if cmp == '<=':
+        return value <= operand
+    if cmp == '==':
+        return value == operand
+    if cmp == '!=':
+        return value != operand
+    raise ValueError(cmp)
+
+
+def shard_batch(tensors: Dict[str, np.ndarray], mesh=None) -> Dict[str, Any]:
+    """Place batch tensors on a 1-D data-parallel mesh."""
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in tensors.items()}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    out = {}
+    for k, v in tensors.items():
+        spec = P('data', *([None] * (v.ndim - 1)))
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

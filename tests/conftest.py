@@ -2,8 +2,9 @@ import os
 import sys
 
 # Force a virtual 8-device CPU mesh for all sharding tests; must be set before
-# jax is imported anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# jax is imported anywhere in the test session. Override unconditionally —
+# the ambient environment may point JAX_PLATFORMS at a real TPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

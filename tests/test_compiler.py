@@ -1,0 +1,216 @@
+import itertools
+import random
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+POLICY_PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: disallow-latest-tag
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: require-image-tag
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "An image tag is required."
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-resources
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: validate-resources
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "resource requests and limits required"
+        pattern:
+          spec:
+            containers:
+              - resources:
+                  requests:
+                    memory: "?*"
+                    cpu: "?*"
+                  limits:
+                    memory: "<4Gi"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: check-replicas
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: replica-bounds
+      match: {any: [{resources: {kinds: [Deployment]}}]}
+      validate:
+        message: "replicas must be 1-10"
+        pattern:
+          spec:
+            replicas: "1-10"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: conditional-pull-policy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: latest-needs-always
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "latest images need Always pull policy"
+        pattern:
+          spec:
+            containers:
+              - (image): "*:latest"
+                imagePullPolicy: Always
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: no-host-network
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: host-network-false
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "host network not allowed"
+        pattern:
+          spec:
+            =(hostNetwork): false
+"""
+
+
+def load_pack():
+    return [Policy(d) for d in yaml.safe_load_all(POLICY_PACK)]
+
+
+def make_pod(rng):
+    """Randomized pod exercising edge cases."""
+    containers = []
+    for i in range(rng.randint(1, 4)):
+        c = {'name': f'c{i}'}
+        img = rng.choice(['nginx:1.25', 'nginx:latest', 'redis', 'app:v2',
+                          'ghcr.io/x/y:latest', ''])
+        c['image'] = img
+        if rng.random() < 0.7:
+            c['imagePullPolicy'] = rng.choice(['Always', 'IfNotPresent'])
+        if rng.random() < 0.8:
+            res = {}
+            if rng.random() < 0.8:
+                res['requests'] = {
+                    'memory': rng.choice(['64Mi', '1Gi', '', '128974848']),
+                    'cpu': rng.choice(['100m', '1', '0.5']),
+                }
+            if rng.random() < 0.8:
+                res['limits'] = {'memory': rng.choice(
+                    ['128Mi', '4Gi', '8Gi', '3.9Gi', '4096Mi'])}
+            c['resources'] = res
+        containers.append(c)
+    spec = {'containers': containers}
+    r = rng.random()
+    if r < 0.2:
+        spec['hostNetwork'] = True
+    elif r < 0.4:
+        spec['hostNetwork'] = False
+    pod = {'apiVersion': 'v1', 'kind': 'Pod',
+           'metadata': {'name': f'pod-{rng.randint(0, 999)}',
+                        'namespace': 'default'},
+           'spec': spec}
+    if rng.random() < 0.1:
+        del pod['spec']['containers']
+    return pod
+
+
+def make_deployment(rng):
+    replicas = rng.choice([0, 1, 5, 10, 11, '3', None])
+    spec = {}
+    if replicas is not None:
+        spec['replicas'] = replicas
+    return {'apiVersion': 'apps/v1', 'kind': 'Deployment',
+            'metadata': {'name': 'd', 'namespace': 'default'},
+            'spec': spec}
+
+
+class TestCompile:
+    def test_pack_fully_compiles(self):
+        cps = compile_policies(load_pack())
+        assert len(cps.programs) == 5
+        assert cps.host_rules == []
+
+    def test_fallback_for_unsupported(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: x
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: needs-vars
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: m
+        pattern:
+          metadata:
+            name: "{{request.object.metadata.namespace}}-*"
+"""))
+        cps = compile_policies([policy])
+        assert len(cps.programs) == 0
+        assert len(cps.host_rules) == 1
+
+
+class TestEquivalence:
+    def test_device_vs_host(self):
+        policies = load_pack()
+        engine = Engine()
+        rng = random.Random(7)
+        resources = [make_pod(rng) for _ in range(60)] + \
+                    [make_deployment(rng) for _ in range(20)]
+
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+
+        for resource, responses in zip(resources, scanned):
+            host = {}
+            for policy in policies:
+                resp = engine.apply_background_checks(
+                    PolicyContext(policy, new_resource=resource))
+                if resp.policy_response.rules:
+                    host[policy.name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            got = {}
+            for resp in responses:
+                if resp.policy_response.rules:
+                    got[resp.policy_response.policy_name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            assert got == host, f'divergence on {resource}'
+
+
+class TestScannerShapes:
+    def test_empty_batch(self):
+        assert BatchScanner(load_pack()).scan([]) == []
+
+    def test_non_matching_kind(self):
+        scanner = BatchScanner(load_pack())
+        out = scanner.scan([{'apiVersion': 'v1', 'kind': 'Service',
+                             'metadata': {'name': 's', 'namespace': 'x'},
+                             'spec': {}}])
+        assert out == [[]]
