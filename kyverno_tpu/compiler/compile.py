@@ -25,7 +25,7 @@ from ..engine.variables import is_reference, is_variable
 from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
 from .ir import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT, CMP_NE, MAX_ELEMS,
-                 STR_LEN, BoolExpr, CompiledPolicySet, CompileError,
+                 STR_LEN, TAIL_LEN, BoolExpr, CompiledPolicySet, CompileError,
                  ElementBlock, Leaf, RuleProgram, Slot)
 
 _CMP_OF_OP = {
@@ -133,9 +133,9 @@ def _walk_map(cps: CompiledPolicySet, pattern: dict, path: Tuple[str, ...],
                                             missing_ok=False))
             continue
         if a is not None and anchor_mod.is_negation(a):
-            slot_id_path = child_path
-            scalar_parts.append(BoolExpr.of(
-                Leaf(Slot(slot_id_path), 'absent')))
+            slot = Slot(child_path)
+            cps.slot_id(slot)
+            scalar_parts.append(BoolExpr.of(Leaf(slot, 'absent')))
             continue
         if a is not None and anchor_mod.is_existence(a):
             if not isinstance(value, list) or not value or \
@@ -202,7 +202,9 @@ def _compile_element_block(cps: CompiledPolicySet, array_path: Tuple[str, ...],
                                             missing_ok=False))
             continue
         if a is not None and anchor_mod.is_negation(a):
-            cons_parts.append(BoolExpr.of(Leaf(Slot(slot_path), 'absent')))
+            slot = Slot(slot_path)
+            cps.slot_id(slot)
+            cons_parts.append(BoolExpr.of(Leaf(slot, 'absent')))
             continue
         if a is not None and not anchor_mod.is_equality(a):
             raise CompileError(f'anchor {key} not vectorized in elements')
@@ -219,11 +221,16 @@ def _compile_element_block(cps: CompiledPolicySet, array_path: Tuple[str, ...],
     if not cons_parts and not cond_parts:
         raise CompileError('empty element pattern')
     condition = BoolExpr.all(cond_parts) if cond_parts else None
-    constraint = BoolExpr.all(cons_parts) if cons_parts else \
-        BoolExpr.of(Leaf(Slot(array_path + ('*',)), 'true'))
+    if cons_parts:
+        constraint = BoolExpr.all(cons_parts)
+    else:
+        true_slot = Slot(array_path + ('*',))
+        cps.slot_id(true_slot)
+        constraint = BoolExpr.of(Leaf(true_slot, 'true'))
     if mode == 'exists':
         return ElementBlock(array_path=array_path, condition=None,
-                            constraint=BoolExpr.all(cond_parts + cons_parts))
+                            constraint=BoolExpr.all(cond_parts + cons_parts),
+                            mode='exists')
     return ElementBlock(array_path=array_path, condition=condition,
                         constraint=constraint)
 
@@ -237,7 +244,9 @@ def _flatten_nested(cps: CompiledPolicySet, base_path: Tuple[str, ...],
         a = anchor_mod.parse(key)
         bare = a.key if a else key
         if a is not None and anchor_mod.is_negation(a):
-            out.append(BoolExpr.of(Leaf(Slot(base_path + (bare,)), 'absent')))
+            slot = Slot(base_path + (bare,))
+            cps.slot_id(slot)
+            out.append(BoolExpr.of(Leaf(slot, 'absent')))
             continue
         if a is not None and not anchor_mod.is_equality(a):
             raise CompileError('nested anchors not vectorized')
@@ -373,12 +382,12 @@ def _compile_wildcard_eq(slot: Slot, operand: str,
     if len(parts) == 2 and parts[0] and not parts[1]:
         return L('prefix', parts[0])
     if len(parts) == 2 and not parts[0] and parts[1]:
-        if len(parts[1].encode()) > 16:
+        if len(parts[1].encode()) > TAIL_LEN:
             raise CompileError('suffix longer than tail window')
         return L('suffix', parts[1])
     if len(parts) == 3 and parts[0] and parts[2] and not parts[1]:
         # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
-        if len(parts[2].encode()) > 16:
+        if len(parts[2].encode()) > TAIL_LEN:
             raise CompileError('suffix longer than tail window')
         return BoolExpr.all([
             L('prefix', parts[0]), L('suffix', parts[2]),

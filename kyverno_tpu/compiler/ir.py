@@ -37,6 +37,8 @@ TAG_ARRAY = 7
 
 # maximum string bytes kept per value (suffix-matched strings keep the tail)
 STR_LEN = 64
+# bytes kept from the end of each string (right-aligned suffix window)
+TAIL_LEN = 16
 # maximum array elements encoded per element-bearing slot
 MAX_ELEMS = 16
 
@@ -60,24 +62,26 @@ class Slot:
         return '.'.join(self.path)
 
 
-# Leaf check ops
-OP_EXISTS = 'exists'            # "?*": non-empty scalar
-OP_STAR = 'star'                # "*": key present and non-null
-OP_EQ_STR = 'eq_str'
-OP_NE_STR = 'ne_str'
-OP_PREFIX = 'prefix'
-OP_NOT_PREFIX = 'not_prefix'
-OP_SUFFIX = 'suffix'
-OP_NOT_SUFFIX = 'not_suffix'
-OP_CONTAINS = 'contains'
-OP_NOT_CONTAINS = 'not_contains'
-OP_CMP_NUM = 'cmp_num'          # operand: (cmp, float)
-OP_CMP_QTY = 'cmp_qty'          # operand: (cmp, milli int)
-OP_CMP_DUR = 'cmp_dur'          # operand: (cmp, nanos int)
-OP_EQ_BOOL = 'eq_bool'
-OP_EQ_NULL = 'eq_null'
-OP_EQ_NUM = 'eq_num'
-OP_TRUE = 'true'
+# Leaf-check op vocabulary — the single source of truth; the compiler emits
+# exactly these strings and ops/eval.py implements exactly this set.
+LEAF_OPS = frozenset({
+    'true',         # constant pass
+    'absent',       # key missing (X() negation anchors)
+    'star',         # "*": key present and non-null
+    'any_str',      # wildcard "*" string compare: any string-convertible
+    'nonempty',     # "?*": non-empty string form
+    'convertible',  # value has a string form (guards NotEqual)
+    'eq_bool',      # operand: bool
+    'eq_null',
+    'eq_int',       # operand: int
+    'eq_float',     # operand: float (milli-exact)
+    'cmp_qty',      # operand: (cmp, milli int)
+    'cmp_dur',      # operand: (cmp, nanos int)
+    'eq_str',       # operand: str (exact, ≤ STR_LEN bytes)
+    'prefix',       # operand: str (≤ STR_LEN bytes)
+    'suffix',       # operand: str (≤ TAIL_LEN bytes)
+    'min_len',      # operand: int (byte length lower bound)
+})
 
 CMP_GT, CMP_GE, CMP_LT, CMP_LE, CMP_EQ, CMP_NE = '>', '>=', '<', '<=', '==', '!='
 
@@ -123,16 +127,22 @@ class BoolExpr:
 
 @dataclass(frozen=True)
 class ElementBlock:
-    """Per-element tri-state semantics for one array-of-maps pattern
-    (reference: pkg/engine/validate/validate.go:218 validateArrayOfMaps).
+    """Per-element tri-state semantics for one array pattern.
 
-    For each element: if ``condition`` (conditional anchors) fails →
-    element SKIP; else ``constraint`` must hold → else FAIL.
-    Rule-level: any FAIL → fail; no FAIL and applyCount==0 with skips → skip.
+    ``mode='forall'`` (reference: pkg/engine/validate/validate.go:218
+    validateArrayOfMaps): per element, if ``condition`` fails → element
+    SKIP; else ``constraint`` must hold → else FAIL. Rule-level: any FAIL →
+    fail; no FAIL and applyCount==0 with skips → skip. A missing/non-array
+    value fails.
+
+    ``mode='exists'`` (reference: pkg/engine/anchor/handlers.go:228
+    existence anchor): at least one element must satisfy ``constraint``;
+    an empty array fails, a missing key passes.
     """
     array_path: Tuple[str, ...]
     condition: Optional[BoolExpr]   # None = unconditional
     constraint: BoolExpr
+    mode: str = 'forall'
 
 
 @dataclass(frozen=True, eq=False)

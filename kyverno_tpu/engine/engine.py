@@ -10,7 +10,7 @@ bit-compatible rule messages and statuses.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api.policy import Policy, Rule
 from ..api.unstructured import Resource
@@ -130,16 +130,21 @@ class Engine:
             pss_evaluator = evaluate_pod_security
         self.pss_evaluator = pss_evaluator
         # autogen expansion memo: policies are immutable during evaluation
-        self._rules_cache: Dict[int, List[dict]] = {}
+        self._rules_cache: Dict[int, Tuple[dict, List[dict]]] = {}
+
+    _RULES_CACHE_MAX = 512
 
     def _compute_rules(self, policy: Policy) -> List[dict]:
         # the cache entry holds a strong reference to the keyed dict so the
-        # id cannot be recycled; identity is re-verified on every hit
+        # id cannot be recycled; identity is re-verified on every hit and
+        # the cache is bounded (FIFO eviction) for long-lived engines
         key = id(policy.raw)
         entry = self._rules_cache.get(key)
         if entry is not None and entry[0] is policy.raw:
             return entry[1]
         rules = compute_rules(policy)
+        if len(self._rules_cache) >= self._RULES_CACHE_MAX:
+            self._rules_cache.pop(next(iter(self._rules_cache)))
         self._rules_cache[key] = (policy.raw, rules)
         return rules
 

@@ -18,13 +18,7 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 import jax
-
-# i64 lanes are required: quantity milli-values span past 2^31 (e.g. 4Gi
-# milli ≈ 4.3e12). TPU lowers s64 compares to paired s32 ops; throughput
-# impact is negligible for elementwise predicates.
-jax.config.update('jax_enable_x64', True)
-
-import jax.numpy as jnp  # noqa: E402
+import jax.numpy as jnp
 
 from ..compiler.encode import TAIL_LEN, Batch
 from ..compiler.ir import (MAX_ELEMS, STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT,
@@ -185,6 +179,16 @@ def build_evaluator(cps: CompiledPolicySet):
                 cond = jnp.broadcast_to(cond[:, None], valid.shape)
         else:
             cond = jnp.ones_like(valid)
+        if block.mode == 'exists':
+            # existence anchor: ≥1 element must satisfy; empty array fails,
+            # missing key passes (reference: anchor/handlers.go:228)
+            satisfied = jnp.any(valid & cons, axis=1)
+            missing = arr_tag == TAG_MISSING
+            wrong_type = (arr_tag != TAG_ARRAY) & ~missing
+            status = jnp.where(
+                missing, STATUS_PASS,
+                jnp.where(wrong_type | ~satisfied, STATUS_FAIL, STATUS_PASS))
+            return status.astype(jnp.int8)
         fail_e = valid & cond & ~cons
         skip_e = valid & ~cond
         pass_e = valid & cond & cons
@@ -227,7 +231,22 @@ def build_evaluator(cps: CompiledPolicySet):
             return jnp.zeros((n, 0), jnp.int8)
         return jnp.stack(cols, axis=1)
 
-    return jax.jit(evaluate)
+    jitted = jax.jit(evaluate)
+
+    def call(t: Dict[str, Any]) -> jnp.ndarray:
+        # i64 lanes are required: quantity milli-values span past 2^31
+        # (4Gi milli ≈ 4.3e12). Scope x64 to this call instead of flipping
+        # the process-global flag at import time; transfers of the int64
+        # inputs must happen inside the scope too (see shard_batch).
+        with enable_x64():
+            return jitted(t)
+
+    call.jitted = jitted
+    return call
+
+
+def enable_x64():
+    return jax.enable_x64()
 
 
 def _cmp(value, operand, cmp):
@@ -246,13 +265,16 @@ def _cmp(value, operand, cmp):
     raise ValueError(cmp)
 
 
-def shard_batch(tensors: Dict[str, np.ndarray], mesh=None) -> Dict[str, Any]:
-    """Place batch tensors on a 1-D data-parallel mesh."""
-    if mesh is None:
-        return {k: jnp.asarray(v) for k, v in tensors.items()}
+def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
+                axis: str = 'data') -> Dict[str, Any]:
+    """Place batch tensors, optionally sharded over a 1-D mesh. int64
+    inputs are transferred inside an x64 scope so they are not downcast."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    out = {}
-    for k, v in tensors.items():
-        spec = P('data', *([None] * (v.ndim - 1)))
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
-    return out
+    with enable_x64():
+        if mesh is None:
+            return {k: jnp.asarray(v) for k, v in tensors.items()}
+        out = {}
+        for k, v in tensors.items():
+            spec = P(axis, *([None] * (v.ndim - 1)))
+            out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+        return out

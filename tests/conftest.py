@@ -1,12 +1,17 @@
 import os
 import sys
 
-# Force a virtual 8-device CPU mesh for all sharding tests; must be set before
-# jax is imported anywhere in the test session. Override unconditionally —
-# the ambient environment may point JAX_PLATFORMS at a real TPU.
+# Force a virtual 8-device CPU mesh for all sharding tests; must happen
+# before any jax backend initialization. The ambient environment registers a
+# real-TPU PJRT plugin via sitecustomize and pins JAX_PLATFORMS, so the env
+# var alone is not enough — override the jax config directly.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

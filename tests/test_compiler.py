@@ -214,3 +214,71 @@ class TestScannerShapes:
                              'metadata': {'name': 's', 'namespace': 'x'},
                              'spec': {}}])
         assert out == [[]]
+
+
+ANCHOR_POLICIES = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-proxy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: must-have-proxy
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "istio-proxy container required"
+        pattern:
+          spec:
+            ^(containers):
+              - name: istio-proxy
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: no-host-network-key
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: no-hostnetwork
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "hostNetwork may not be set"
+        pattern:
+          spec:
+            X(hostNetwork): "null"
+"""
+
+
+class TestAnchorEquivalence:
+    def test_exists_and_negation_anchors(self):
+        policies = [Policy(d) for d in yaml.safe_load_all(ANCHOR_POLICIES)]
+        cps = compile_policies(policies)
+        assert cps.host_rules == []
+        engine = Engine()
+        cases = [
+            {'spec': {'containers': []}},                       # exists: fail
+            {'spec': {'containers': [{'name': 'istio-proxy'}]}},  # pass
+            {'spec': {'containers': [{'name': 'app'}]}},        # exists: fail
+            {'spec': {}},                                       # missing: pass
+            {'spec': {'hostNetwork': True,
+                      'containers': [{'name': 'istio-proxy'}]}},  # neg: fail
+        ]
+        resources = [{'apiVersion': 'v1', 'kind': 'Pod',
+                      'metadata': {'name': f'p{i}', 'namespace': 'd'}, **c}
+                     for i, c in enumerate(cases)]
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+        for resource, responses in zip(resources, scanned):
+            host = {}
+            for policy in policies:
+                resp = engine.apply_background_checks(
+                    PolicyContext(policy, new_resource=resource))
+                if resp.policy_response.rules:
+                    host[policy.name] = {r.name: (r.status, r.message)
+                                         for r in resp.policy_response.rules}
+            got = {r.policy_response.policy_name:
+                   {x.name: (x.status, x.message)
+                    for x in r.policy_response.rules}
+                   for r in responses if r.policy_response.rules}
+            assert got == host, f'divergence on {resource}'
