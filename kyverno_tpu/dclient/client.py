@@ -1,0 +1,201 @@
+"""In-memory dynamic client (reference: pkg/clients/dclient/client.go:22,
+fake.go).
+
+Resources are stored unstructured, keyed by (apiVersion, kind, namespace,
+name). Namespaces are themselves resources (v1/Namespace) so namespace
+label lookups go through the same store. The client maintains
+``resourceVersion`` counters the way the API server does, which the
+generate controller's synchronize semantics depend on.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..engine.match import check_selector
+
+
+class ApiError(Exception):
+    """Base API error (reference: k8s.io/apimachinery apierrors)."""
+
+    reason = 'InternalError'
+
+
+class NotFoundError(ApiError):
+    reason = 'NotFound'
+
+
+class AlreadyExistsError(ApiError):
+    reason = 'AlreadyExists'
+
+
+Key = Tuple[str, str, str, str]
+
+
+def _key(api_version: str, kind: str, namespace: str, name: str) -> Key:
+    return (api_version or '', kind or '', namespace or '', name or '')
+
+
+class FakeClient:
+    """In-memory dclient.Interface (reference: pkg/clients/dclient/fake.go).
+
+    Thread-safe: controllers run in worker threads the way the reference's
+    workqueue workers do.
+    """
+
+    def __init__(self):
+        self._store: Dict[Key, dict] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        # subscribers get (event_type, resource) for informer-style wiring
+        self._watchers: List[Callable[[str, dict], None]] = []
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, fn: Callable[[str, dict], None]) -> None:
+        """Register an informer-style event callback ('ADDED'/'MODIFIED'/
+        'DELETED', resource)."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _notify(self, event: str, resource: dict) -> None:
+        for fn in list(self._watchers):
+            fn(event, copy.deepcopy(resource))
+
+    # -- core verbs ----------------------------------------------------------
+
+    def get_resource(self, api_version: str, kind: str, namespace: str,
+                     name: str, subresource: str = '') -> dict:
+        """reference: dclient.GetResource"""
+        with self._lock:
+            obj = self._store.get(_key(api_version, kind, namespace, name))
+            if obj is None:
+                obj = self._lookup_any_version(api_version, kind, namespace, name)
+            if obj is None:
+                raise NotFoundError(
+                    f'{kind} "{namespace + "/" if namespace else ""}{name}" not found')
+            if subresource:
+                sub = obj.get(subresource)
+                return copy.deepcopy(sub) if sub is not None else {}
+            return copy.deepcopy(obj)
+
+    def _lookup_any_version(self, api_version: str, kind: str,
+                            namespace: str, name: str) -> Optional[dict]:
+        # discovery fallback: empty apiVersion matches any stored version
+        if api_version:
+            return None
+        for (av, k, ns, n), obj in self._store.items():
+            if k == kind and ns == (namespace or '') and n == name:
+                return obj
+        return None
+
+    def create_resource(self, api_version: str, kind: str, namespace: str,
+                        resource: dict, dry_run: bool = False) -> dict:
+        """reference: dclient.CreateResource"""
+        obj = copy.deepcopy(resource)
+        meta = obj.setdefault('metadata', {})
+        name = meta.get('name', '')
+        ns = meta.get('namespace', namespace or '')
+        if namespace and not meta.get('namespace') and kind != 'Namespace':
+            meta['namespace'] = namespace
+            ns = namespace
+        obj.setdefault('apiVersion', api_version)
+        obj.setdefault('kind', kind)
+        key = _key(obj['apiVersion'], obj['kind'], ns if kind != 'Namespace' else '', name)
+        with self._lock:
+            if key in self._store:
+                raise AlreadyExistsError(f'{kind} "{name}" already exists')
+            if dry_run:
+                return obj
+            self._rv += 1
+            meta['resourceVersion'] = str(self._rv)
+            self._store[key] = obj
+            out = copy.deepcopy(obj)
+        self._notify('ADDED', obj)
+        return out
+
+    def update_resource(self, api_version: str, kind: str, namespace: str,
+                        resource: dict, dry_run: bool = False,
+                        subresource: str = '') -> dict:
+        """reference: dclient.UpdateResource / UpdateStatusResource"""
+        obj = copy.deepcopy(resource)
+        meta = obj.setdefault('metadata', {})
+        name = meta.get('name', '')
+        ns = namespace if kind != 'Namespace' else ''
+        key = _key(api_version or obj.get('apiVersion', ''),
+                   kind or obj.get('kind', ''), ns or meta.get('namespace', ''), name)
+        with self._lock:
+            if key not in self._store:
+                raise NotFoundError(f'{kind} "{name}" not found')
+            if dry_run:
+                return obj
+            self._rv += 1
+            meta['resourceVersion'] = str(self._rv)
+            obj.setdefault('apiVersion', api_version)
+            obj.setdefault('kind', kind)
+            self._store[key] = obj
+            out = copy.deepcopy(obj)
+        self._notify('MODIFIED', obj)
+        return out
+
+    def update_status_resource(self, api_version: str, kind: str,
+                               namespace: str, resource: dict,
+                               dry_run: bool = False) -> dict:
+        return self.update_resource(api_version, kind, namespace, resource,
+                                    dry_run, subresource='status')
+
+    def delete_resource(self, api_version: str, kind: str, namespace: str,
+                        name: str, dry_run: bool = False) -> None:
+        """reference: dclient.DeleteResource"""
+        with self._lock:
+            key = _key(api_version, kind, namespace if kind != 'Namespace' else '', name)
+            obj = self._store.get(key)
+            if obj is None and not api_version:
+                obj = self._lookup_any_version('', kind, namespace, name)
+                if obj is not None:
+                    key = _key(obj.get('apiVersion', ''), kind,
+                               namespace if kind != 'Namespace' else '', name)
+            if obj is None:
+                raise NotFoundError(f'{kind} "{name}" not found')
+            if dry_run:
+                return
+            del self._store[key]
+        self._notify('DELETED', obj)
+
+    def list_resource(self, api_version: str, kind: str, namespace: str = '',
+                      selector: Optional[dict] = None) -> List[dict]:
+        """reference: dclient.ListResource (label selector honored)."""
+        out = []
+        with self._lock:
+            items = list(self._store.values())
+        for obj in items:
+            if kind and obj.get('kind') != kind:
+                continue
+            if api_version and obj.get('apiVersion') != api_version:
+                continue
+            meta = obj.get('metadata') or {}
+            if namespace and meta.get('namespace', '') != namespace:
+                continue
+            if selector is not None:
+                labels = {str(k): str(v)
+                          for k, v in (meta.get('labels') or {}).items()}
+                if not check_selector(selector, labels):
+                    continue
+            out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: ((o.get('metadata') or {}).get('namespace', ''),
+                                (o.get('metadata') or {}).get('name', '')))
+        return out
+
+    # -- namespace helpers ---------------------------------------------------
+
+    def get_namespace_labels(self, namespace: str) -> Dict[str, str]:
+        """Namespace labels for match-time `namespaceSelector` evaluation
+        (reference: pkg/utils/kube GetNamespaceSelectorsFromNamespaceLister)."""
+        try:
+            ns = self.get_resource('v1', 'Namespace', '', namespace)
+        except NotFoundError:
+            return {}
+        labels = (ns.get('metadata') or {}).get('labels') or {}
+        return {str(k): str(v) for k, v in labels.items()}

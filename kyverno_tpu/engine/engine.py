@@ -172,6 +172,18 @@ class Engine:
             return resp
         return self.validate(policy_context)
 
+    def filter_background_rules(self, policy_context: PolicyContext) -> EngineResponse:
+        """Filter generate / mutate-existing rules applicable to a trigger
+        (reference: pkg/engine/background.go:20 ApplyBackgroundChecks)."""
+        from .background import filter_background_rules as impl
+        return impl(self, policy_context)
+
+    def generate_response(self, policy_context: PolicyContext,
+                          ur: dict) -> EngineResponse:
+        """reference: pkg/engine/generation.go:14 GenerateResponse"""
+        from .background import generate_response as impl
+        return impl(self, policy_context, ur)
+
     # -- internals -----------------------------------------------------------
 
     def _build_response(self, pctx: PolicyContext, resp: EngineResponse,

@@ -73,6 +73,23 @@ def shard_tensors(tensors: Dict[str, np.ndarray], mesh: Mesh,
     return shard_batch(tensors, mesh, axis)
 
 
+# (cps id, mesh, axis) -> sharded evaluator; the cps entry keeps a strong
+# reference to the keyed object so ids cannot be recycled
+_SHARDED_CACHE: Dict[Tuple[int, Mesh, str], Tuple[CompiledPolicySet, Any]] = {}
+
+
+def _cached_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh, axis: str):
+    key = (id(cps), mesh, axis)
+    hit = _SHARDED_CACHE.get(key)
+    if hit is not None and hit[0] is cps:
+        return hit[1]
+    step = build_sharded_evaluator(cps, mesh, axis)
+    if len(_SHARDED_CACHE) > 64:
+        _SHARDED_CACHE.clear()
+    _SHARDED_CACHE[key] = (cps, step)
+    return step
+
+
 def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
                           resources: List[dict], axis: str = 'data'):
     """Encode + evaluate a batch across the mesh; returns (statuses, summary).
@@ -86,6 +103,6 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     padded = pad_to_multiple(max(n, n_dev), n_dev)
     batch = encode_batch(resources, cps, padded_n=padded)
     tensors = shard_tensors(batch.tensors(), mesh, axis)
-    step = build_sharded_evaluator(cps, mesh, axis)
+    step = _cached_sharded_evaluator(cps, mesh, axis)
     statuses, summary = step(tensors)
     return np.asarray(statuses)[:n], np.asarray(summary)

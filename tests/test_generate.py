@@ -1,0 +1,286 @@
+"""Generate-rule + UpdateRequest background flow tests
+(reference behavior: pkg/background/generate/generate.go,
+pkg/webhooks/updaterequest/generator.go)."""
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.background import (
+    STATE_COMPLETED, STATE_PENDING, UpdateRequest, UpdateRequestController,
+    UpdateRequestGenerator,
+)
+from kyverno_tpu.background.updaterequest import (
+    KYVERNO_NAMESPACE, UR_GENERATE, UR_MUTATE, new_ur_spec,
+)
+from kyverno_tpu.dclient import FakeClient, NotFoundError
+from kyverno_tpu.engine.engine import Engine
+
+
+GEN_DATA_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-networkpolicy
+spec:
+  generateExistingOnPolicyUpdate: false
+  rules:
+    - name: default-deny
+      match:
+        any:
+          - resources:
+              kinds: [Namespace]
+      generate:
+        apiVersion: networking.k8s.io/v1
+        kind: NetworkPolicy
+        name: default-deny
+        namespace: "{{request.object.metadata.name}}"
+        synchronize: true
+        data:
+          spec:
+            podSelector: {}
+            policyTypes: [Ingress, Egress]
+"""
+
+CLONE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: sync-secrets
+spec:
+  rules:
+    - name: clone-regcred
+      match:
+        any:
+          - resources:
+              kinds: [Namespace]
+      generate:
+        apiVersion: v1
+        kind: Secret
+        name: regcred
+        namespace: "{{request.object.metadata.name}}"
+        synchronize: true
+        clone:
+          namespace: default
+          name: regcred
+"""
+
+
+def _namespace(name):
+    return {'apiVersion': 'v1', 'kind': 'Namespace',
+            'metadata': {'name': name}}
+
+
+def _setup(policy_yaml):
+    client = FakeClient()
+    policy_raw = yaml.safe_load(policy_yaml)
+    client.create_resource('kyverno.io/v1', 'ClusterPolicy', '', policy_raw)
+    engine = Engine()
+    ctrl = UpdateRequestController(client, engine)
+    gen = UpdateRequestGenerator(client)
+    return client, ctrl, gen
+
+
+def _enqueue(gen, client, policy_name, trigger, rtype=UR_GENERATE):
+    spec = new_ur_spec(rtype, policy_name, trigger)
+    return gen.apply(spec)
+
+
+class TestGenerateData:
+    def test_data_rule_creates_target(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('apps')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        assert ctrl.process_pending() == 1
+        np = client.get_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                                 'apps', 'default-deny')
+        assert np['spec']['policyTypes'] == ['Ingress', 'Egress']
+        labels = np['metadata']['labels']
+        assert labels['app.kubernetes.io/managed-by'] == 'kyverno'
+        assert labels['kyverno.io/generated-by-kind'] == 'Namespace'
+        assert labels['kyverno.io/generated-by-name'] == 'apps'
+        assert labels['policy.kyverno.io/synchronize'] == 'enable'
+
+    def test_ur_status_completed_and_generated_resources(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('team-a')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        ctrl.process_pending()
+        urs = ctrl.list_urs()
+        assert len(urs) == 1
+        assert urs[0].state == STATE_COMPLETED
+        gr = urs[0].generated_resources
+        assert gr == [{'apiVersion': 'networking.k8s.io/v1',
+                       'kind': 'NetworkPolicy', 'namespace': 'team-a',
+                       'name': 'default-deny'}]
+
+    def test_synchronize_updates_drifted_target(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('apps')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        ctrl.process_pending()
+        # drift the generated resource
+        np = client.get_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                                 'apps', 'default-deny')
+        np['spec']['policyTypes'] = ['Ingress']
+        client.update_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                               'apps', np)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        ctrl.process_pending()
+        np2 = client.get_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                                  'apps', 'default-deny')
+        assert np2['spec']['policyTypes'] == ['Ingress', 'Egress']
+
+    def test_non_matching_trigger_generates_nothing(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        pod = {'apiVersion': 'v1', 'kind': 'Pod',
+               'metadata': {'name': 'p', 'namespace': 'default'}}
+        client.create_resource('v1', 'Pod', 'default', pod)
+        _enqueue(gen, client, 'add-networkpolicy', pod)
+        ctrl.process_pending()
+        assert client.list_resource('networking.k8s.io/v1',
+                                    'NetworkPolicy') == []
+
+
+class TestGenerateClone:
+    def test_clone_secret_into_new_namespace(self):
+        client, ctrl, gen = _setup(CLONE_POLICY)
+        client.create_resource('v1', 'Secret', 'default', {
+            'apiVersion': 'v1', 'kind': 'Secret',
+            'metadata': {'name': 'regcred', 'namespace': 'default'},
+            'type': 'kubernetes.io/dockerconfigjson',
+            'data': {'.dockerconfigjson': 'e30='},
+        })
+        ns = _namespace('team-b')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'sync-secrets', ns)
+        ctrl.process_pending()
+        cloned = client.get_resource('v1', 'Secret', 'team-b', 'regcred')
+        assert cloned['data'] == {'.dockerconfigjson': 'e30='}
+        assert cloned['type'] == 'kubernetes.io/dockerconfigjson'
+
+    def test_clone_missing_source_fails_ur(self):
+        client, ctrl, gen = _setup(CLONE_POLICY)
+        ns = _namespace('team-c')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'sync-secrets', ns)
+        ctrl.process_pending()
+        urs = ctrl.list_urs()
+        # retried: stays pending with an error message until MAX_RETRIES
+        assert urs[0].state == STATE_PENDING
+        assert 'not found' in urs[0].status.get('message', '')
+
+
+class TestDownstreamCleanup:
+    def test_fresh_ur_for_retired_trigger_deletes_by_labels(self):
+        """A new UR (empty status) whose trigger no longer matches must
+        still locate and delete downstream resources via ownership labels
+        (reference: generate.go deleteDownstream by label query)."""
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('apps')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        ctrl.process_pending()
+        ctrl.cleanup_completed()  # drop the completed UR and its status
+        client.get_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                            'apps', 'default-deny')
+        # retire the trigger: DELETE operation with oldObject matching
+        spec = new_ur_spec(UR_GENERATE, 'add-networkpolicy', ns,
+                           admission_request={'operation': 'DELETE',
+                                              'oldObject': ns},
+                           operation='DELETE')
+        client.delete_resource('v1', 'Namespace', '', 'apps')
+        gen.apply(spec)
+        ctrl.process_pending()
+        assert client.list_resource('networking.k8s.io/v1',
+                                    'NetworkPolicy') == []
+
+
+class TestURGenerator:
+    def test_dedupes_pending_by_labels(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('apps')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest',
+                                   KYVERNO_NAMESPACE)
+        assert len(urs) == 1
+
+    def test_cleanup_completed(self):
+        client, ctrl, gen = _setup(GEN_DATA_POLICY)
+        ns = _namespace('apps')
+        client.create_resource('v1', 'Namespace', '', ns)
+        _enqueue(gen, client, 'add-networkpolicy', ns)
+        ctrl.process_pending()
+        assert ctrl.cleanup_completed() == 1
+        assert ctrl.list_urs() == []
+
+
+class TestMutateExisting:
+    POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: label-configmaps
+spec:
+  rules:
+    - name: stamp
+      match:
+        any:
+          - resources:
+              kinds: [ConfigMap]
+      mutate:
+        targets:
+          - apiVersion: v1
+            kind: ConfigMap
+            name: app-config
+            namespace: default
+        patchStrategicMerge:
+          metadata:
+            labels:
+              stamped: "true"
+"""
+
+    def test_mutate_existing_target(self):
+        client, ctrl, gen = _setup(self.POLICY)
+        cm = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+              'metadata': {'name': 'app-config', 'namespace': 'default'},
+              'data': {'k': 'v'}}
+        client.create_resource('v1', 'ConfigMap', 'default', cm)
+        trigger = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+                   'metadata': {'name': 'trigger', 'namespace': 'default'}}
+        client.create_resource('v1', 'ConfigMap', 'default', trigger)
+        _enqueue(gen, client, 'label-configmaps', trigger, UR_MUTATE)
+        ctrl.process_pending()
+        urs = ctrl.list_urs()
+        assert urs[0].state == STATE_COMPLETED, urs[0].status
+        patched = client.get_resource('v1', 'ConfigMap', 'default',
+                                      'app-config')
+        assert patched['metadata']['labels']['stamped'] == 'true'
+        assert patched['data'] == {'k': 'v'}
+
+
+class TestBackgroundFilter:
+    def test_filter_reports_pass_for_matching_generate_rule(self):
+        from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+        policy = Policy(yaml.safe_load(GEN_DATA_POLICY))
+        engine = Engine()
+        pctx = PolicyContext(policy=policy, new_resource=_namespace('x'))
+        resp = engine.filter_background_rules(pctx)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.PASS]
+
+    def test_filter_skips_when_preconditions_fail(self):
+        from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+        raw = yaml.safe_load(GEN_DATA_POLICY)
+        raw['spec']['rules'][0]['preconditions'] = {
+            'all': [{'key': '{{request.object.metadata.name}}',
+                     'operator': 'Equals', 'value': 'only-this'}]}
+        engine = Engine()
+        pctx = PolicyContext(policy=Policy(raw), new_resource=_namespace('x'))
+        resp = engine.filter_background_rules(pctx)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.SKIP]
