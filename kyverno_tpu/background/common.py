@@ -14,10 +14,24 @@ from ..engine.context import Context
 from .updaterequest import UpdateRequest
 
 
+def get_policy(client, policy_key: str) -> Policy:
+    """Resolve a UR's policy key (``ns/name`` for namespaced Policy, bare
+    name for ClusterPolicy) from the store (reference:
+    pkg/background/generate/generate.go:267 getPolicySpec)."""
+    if '/' in policy_key:
+        ns, name = policy_key.split('/', 1)
+        raw = client.get_resource('kyverno.io/v1', 'Policy', ns, name)
+    else:
+        raw = client.get_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                                  policy_key)
+    return Policy(raw)
+
+
 def get_trigger_resource(client, ur: UpdateRequest) -> Optional[dict]:
     """reference: pkg/background/common/resource.go:16 GetResource —
-    resolves the trigger from the cluster, falling back to the admission
-    request's oldObject for DELETE operations."""
+    resolves the trigger from the cluster; a trigger deleted (or already
+    terminating) yields None, signalling the caller to skip processing
+    (generate then cleans up downstream targets)."""
     res = ur.resource
     namespace = res.get('namespace', '')
     if res.get('kind') == 'Namespace':

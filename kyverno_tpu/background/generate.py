@@ -19,7 +19,7 @@ from ..dclient.client import AlreadyExistsError, NotFoundError
 from ..engine.api import RuleStatus
 from ..engine.background import generate_response
 from ..engine.variables import substitute_all
-from .common import get_trigger_resource, new_background_context
+from .common import get_policy, get_trigger_resource, new_background_context
 from .labels import (
     BACKGROUND_GEN_RULE_LABEL, GR_NAME_LABEL, POLICY_NAME_LABEL,
     SYNCHRONIZE_LABEL, manage_labels,
@@ -56,19 +56,8 @@ class GenerateController:
         self.client = client
         self.engine = engine
         # policy_getter(policy_key) -> Policy; defaults to the client store
-        self.policy_getter = policy_getter or self._get_policy_from_client
-
-    # -- policy lookup -------------------------------------------------------
-
-    def _get_policy_from_client(self, policy_key: str) -> Policy:
-        """reference: generate.go:267 getPolicySpec"""
-        if '/' in policy_key:
-            ns, name = policy_key.split('/', 1)
-            raw = self.client.get_resource('kyverno.io/v1', 'Policy', ns, name)
-        else:
-            raw = self.client.get_resource(
-                'kyverno.io/v1', 'ClusterPolicy', '', policy_key)
-        return Policy(raw)
+        self.policy_getter = policy_getter or (
+            lambda key: get_policy(client, key))
 
     # -- UR processing -------------------------------------------------------
 

@@ -14,7 +14,7 @@ from ..engine.api import PolicyContext, RuleStatus
 from ..engine.background import is_mutate_existing
 from ..engine.context import Context
 from ..engine.variables import substitute_all
-from .common import get_trigger_resource, new_background_context
+from .common import get_policy, get_trigger_resource, new_background_context
 from .updaterequest import STATE_COMPLETED, STATE_FAILED, UpdateRequest
 
 MUTATE_LAST_APPLIED_ANNOTATION = 'policies.kyverno.io/last-applied-patches'
@@ -26,16 +26,8 @@ class MutateExistingController:
     def __init__(self, client, engine, policy_getter=None):
         self.client = client
         self.engine = engine
-        self.policy_getter = policy_getter or self._get_policy_from_client
-
-    def _get_policy_from_client(self, policy_key: str) -> Policy:
-        if '/' in policy_key:
-            ns, name = policy_key.split('/', 1)
-            raw = self.client.get_resource('kyverno.io/v1', 'Policy', ns, name)
-        else:
-            raw = self.client.get_resource(
-                'kyverno.io/v1', 'ClusterPolicy', '', policy_key)
-        return Policy(raw)
+        self.policy_getter = policy_getter or (
+            lambda key: get_policy(client, key))
 
     def process_ur(self, ur: UpdateRequest) -> Optional[Exception]:
         """reference: mutate.go:73 ProcessUR"""

@@ -1,0 +1,103 @@
+"""Cosign-style verification against a registry client (reference:
+pkg/cosign/cosign.go:63 VerifySignature, :256 FetchAttestations).
+
+A signature entry matches when the attestor's key id equals the stored
+key (static keys), or its subject/issuer match (keyless) — wildcards
+allowed, the same matching the reference performs on certificate
+identity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..utils import wildcard
+from ..registry.client import RegistryError
+
+
+class Options:
+    """reference: pkg/cosign/cosign.go Options (subset used by the engine)"""
+
+    __slots__ = ('image_ref', 'key', 'cert', 'cert_chain', 'roots',
+                 'subject', 'issuer', 'annotations', 'repository',
+                 'ignore_tlog', 'rekor_url', 'predicate_type',
+                 'fetch_attestations')
+
+    def __init__(self, image_ref: str, key: str = '', cert: str = '',
+                 cert_chain: str = '', roots: str = '', subject: str = '',
+                 issuer: str = '', annotations: Optional[dict] = None,
+                 repository: str = '', ignore_tlog: bool = False,
+                 rekor_url: str = '', predicate_type: str = '',
+                 fetch_attestations: bool = False):
+        self.image_ref = image_ref
+        self.key = key
+        self.cert = cert
+        self.cert_chain = cert_chain
+        self.roots = roots
+        self.subject = subject
+        self.issuer = issuer
+        self.annotations = annotations or {}
+        self.repository = repository
+        self.ignore_tlog = ignore_tlog
+        self.rekor_url = rekor_url
+        self.predicate_type = predicate_type
+        self.fetch_attestations = fetch_attestations
+
+
+class Response:
+    """reference: pkg/cosign/cosign.go Response"""
+
+    __slots__ = ('digest', 'statements')
+
+    def __init__(self, digest: str = '', statements: Optional[List[dict]] = None):
+        self.digest = digest
+        self.statements = statements or []
+
+
+def _signature_matches(sig: dict, opts: Options) -> bool:
+    if opts.key:
+        return sig.get('key', '') == opts.key.strip()
+    matched = True
+    if opts.subject:
+        matched = matched and wildcard.match(opts.subject,
+                                             sig.get('subject', ''))
+    if opts.issuer:
+        matched = matched and wildcard.match(opts.issuer,
+                                             sig.get('issuer', ''))
+    if not opts.subject and not opts.issuer:
+        # keyless with no identity constraints: any signature counts
+        matched = bool(sig)
+    return matched
+
+
+def verify_signature(rclient, opts: Options) -> Response:
+    """reference: cosign.go:63 VerifySignature — raises on no match."""
+    try:
+        signatures = rclient.get_signatures(opts.image_ref)
+        digest = rclient.fetch_image_descriptor(opts.image_ref).digest
+    except RegistryError as err:
+        raise err
+    for sig in signatures:
+        if _signature_matches(sig, opts):
+            return Response(digest=digest)
+    raise RegistryError(
+        f'no matching signatures for {opts.image_ref}')
+
+
+def fetch_attestations(rclient, opts: Options) -> Response:
+    """reference: cosign.go:256 FetchAttestations — returns the in-toto
+    statements whose signer matches the attestor options."""
+    try:
+        attestations = rclient.get_attestations(opts.image_ref)
+        digest = rclient.fetch_image_descriptor(opts.image_ref).digest
+    except RegistryError as err:
+        raise err
+    statements = []
+    for att in attestations:
+        sig = {'key': att.get('key', ''), 'subject': att.get('subject', ''),
+               'issuer': att.get('issuer', '')}
+        if opts.key or opts.subject or opts.issuer:
+            if not _signature_matches(sig, opts):
+                continue
+        statements.append(att['statement'])
+    return Response(digest=digest, statements=statements)

@@ -184,6 +184,13 @@ class Engine:
         from .background import generate_response as impl
         return impl(self, policy_context, ur)
 
+    def verify_and_patch_images(self, policy_context: PolicyContext,
+                                rclient=None):
+        """reference: pkg/engine/imageVerify.go:69 VerifyAndPatchImages —
+        returns (EngineResponse, ImageVerificationMetadata)."""
+        from .image_verify import verify_and_patch_images as impl
+        return impl(self, policy_context, rclient)
+
     # -- internals -----------------------------------------------------------
 
     def _build_response(self, pctx: PolicyContext, resp: EngineResponse,
@@ -240,8 +247,11 @@ class Engine:
     def _process_rule(self, pctx: PolicyContext,
                       rule: Rule) -> Optional[RuleResponse]:
         has_validate = rule.has_validate()
+        # reference: api/kyverno/v1/rule_types.go:107
+        # HasImagesValidationChecks (verifyDigest/required default true)
         has_validate_image = any(
-            (iv.get('validate') or {}) for iv in rule.verify_images)
+            iv.get('verifyDigest', True) or iv.get('required', True)
+            for iv in rule.verify_images)
         has_manifests = bool(rule.validation.get('manifests'))
         if not has_validate and not has_validate_image:
             return None
@@ -258,10 +268,8 @@ class Engine:
                                 'manifest verification requires signatures',
                                 RuleStatus.ERROR)
         if has_validate_image:
-            return RuleResponse(
-                rule.name, RuleType.IMAGE_VERIFY,
-                'image verification requires a registry client',
-                RuleStatus.ERROR)
+            from .image_verify import process_image_validation_rule
+            return process_image_validation_rule(self, pctx, rule)
         return None
 
     def _matches(self, rule: Rule, pctx: PolicyContext) -> bool:

@@ -73,19 +73,24 @@ def shard_tensors(tensors: Dict[str, np.ndarray], mesh: Mesh,
     return shard_batch(tensors, mesh, axis)
 
 
-# (cps id, mesh, axis) -> sharded evaluator; the cps entry keeps a strong
-# reference to the keyed object so ids cannot be recycled
-_SHARDED_CACHE: Dict[Tuple[int, Mesh, str], Tuple[CompiledPolicySet, Any]] = {}
+# (cps id, mesh, axis) -> sharded evaluator. LRU with single-entry
+# eviction; the cps entry keeps a strong reference to the keyed object so
+# ids cannot be recycled while cached.
+from collections import OrderedDict
+
+_SHARDED_CACHE: 'OrderedDict[Tuple[int, Mesh, str], Tuple[CompiledPolicySet, Any]]' = OrderedDict()
+_SHARDED_CACHE_MAX = 16
 
 
 def _cached_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh, axis: str):
     key = (id(cps), mesh, axis)
     hit = _SHARDED_CACHE.get(key)
     if hit is not None and hit[0] is cps:
+        _SHARDED_CACHE.move_to_end(key)
         return hit[1]
     step = build_sharded_evaluator(cps, mesh, axis)
-    if len(_SHARDED_CACHE) > 64:
-        _SHARDED_CACHE.clear()
+    while len(_SHARDED_CACHE) >= _SHARDED_CACHE_MAX:
+        _SHARDED_CACHE.popitem(last=False)
     _SHARDED_CACHE[key] = (cps, step)
     return step
 

@@ -1,0 +1,271 @@
+"""verifyImages engine tests (reference behavior:
+pkg/engine/imageVerify_test.go, pkg/utils/image/infos_test.go,
+pkg/utils/api/image_test.go)."""
+
+import json
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.engine.image_verify import (
+    IMAGE_VERIFY_ANNOTATION, ImageVerificationMetadata,
+)
+from kyverno_tpu.registry import MockRegistryClient
+from kyverno_tpu.utils.image import get_image_info
+from kyverno_tpu.utils.image_extract import extract_images_from_resource
+
+DIGEST = 'sha256:' + 'ab' * 32
+
+
+class TestImageInfo:
+    def test_simple_name(self):
+        info = get_image_info('nginx')
+        assert (info.registry, info.path, info.name, info.tag) == \
+            ('docker.io', 'nginx', 'nginx', 'latest')
+        assert str(info) == 'docker.io/nginx:latest'
+
+    def test_registry_and_tag(self):
+        info = get_image_info('ghcr.io/org/app:v1.2')
+        assert (info.registry, info.path, info.tag) == \
+            ('ghcr.io', 'org/app', 'v1.2')
+
+    def test_digest(self):
+        info = get_image_info(f'quay.io/app@{DIGEST}')
+        assert info.digest == DIGEST
+        assert str(info) == f'quay.io/app@{DIGEST}'
+
+    def test_port_registry(self):
+        info = get_image_info('localhost:5000/app:1')
+        assert (info.registry, info.path, info.tag) == \
+            ('localhost:5000', 'app', '1')
+
+    def test_bad_image(self):
+        with pytest.raises(ValueError):
+            get_image_info('Nginx:bad tag::')
+
+    def test_no_registry_mutation(self):
+        info = get_image_info('nginx', enable_default_registry_mutation=False)
+        assert info.registry == ''
+        assert str(info) == 'nginx:latest'
+
+
+class TestExtractors:
+    def test_pod_containers(self):
+        pod = {'kind': 'Pod', 'spec': {
+            'containers': [{'name': 'a', 'image': 'nginx:1'}],
+            'initContainers': [{'name': 'b', 'image': 'busybox:2'}]}}
+        infos = extract_images_from_resource(pod)
+        assert str(infos['containers']['a']) == 'docker.io/nginx:1'
+        assert str(infos['initContainers']['b']) == 'docker.io/busybox:2'
+        assert infos['containers']['a'].pointer == '/spec/containers/0/image'
+
+    def test_deployment_template(self):
+        dep = {'kind': 'Deployment', 'spec': {'template': {'spec': {
+            'containers': [{'name': 'c', 'image': 'redis:7'}]}}}}
+        infos = extract_images_from_resource(dep)
+        assert infos['containers']['c'].pointer == \
+            '/spec/template/spec/containers/0/image'
+
+    def test_cronjob(self):
+        cj = {'kind': 'CronJob', 'spec': {'jobTemplate': {'spec': {
+            'template': {'spec': {'containers': [
+                {'name': 'c', 'image': 'job:1'}]}}}}}}
+        infos = extract_images_from_resource(cj)
+        assert 'c' in infos['containers']
+
+    def test_custom_extractor(self):
+        res = {'kind': 'Task', 'spec': {'steps': [
+            {'name': 's1', 'image': 'tool:3'}]}}
+        configs = {'Task': [{'path': '/spec/steps/*', 'value': 'image',
+                             'key': 'name'}]}
+        infos = extract_images_from_resource(res, configs)
+        assert str(infos['custom']['s1']) == 'docker.io/tool:3'
+
+
+def _pod(image, annotations=None):
+    meta = {'name': 'p', 'namespace': 'default'}
+    if annotations:
+        meta['annotations'] = annotations
+    return {'apiVersion': 'v1', 'kind': 'Pod', 'metadata': meta,
+            'spec': {'containers': [{'name': 'c', 'image': image}]}}
+
+
+def _policy(image_verify):
+    return Policy({
+        'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+        'metadata': {'name': 'verify',
+                     'annotations': {
+                         'pod-policies.kyverno.io/autogen-controllers':
+                         'none'}},
+        'spec': {'rules': [{
+            'name': 'check-sig',
+            'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+            'verifyImages': [image_verify]}]}})
+
+
+def _registry():
+    r = MockRegistryClient()
+    r.add_image('ghcr.io/org/app', DIGEST)
+    r.sign('ghcr.io/org/app', 'key-1')
+    r.attest('ghcr.io/org/app', {
+        'predicateType': 'https://slsa.dev/provenance/v0.2',
+        'predicate': {'builder': {'id': 'github-actions'}}})
+    return r
+
+
+class TestVerifyAndPatchImages:
+    def test_signed_image_passes_and_gets_digest_patch(self):
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestors': [{'entries': [{'keys': {'publicKeys': 'key-1'}}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, ivm = Engine().verify_and_patch_images(pctx, _registry())
+        rules = resp.policy_response.rules
+        assert [r.status for r in rules] == [RuleStatus.PASS]
+        assert ivm.data == {'ghcr.io/org/app:v1': True}
+        patches = rules[0].patches
+        assert patches and patches[0]['path'] == '/spec/containers/0/image'
+        assert patches[0]['value'] == f'ghcr.io/org/app:v1@{DIGEST}'
+
+    def test_wrong_key_fails(self):
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestors': [{'entries': [{'keys': {'publicKeys': 'other'}}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, ivm = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.FAIL]
+        assert ivm.data == {'ghcr.io/org/app:v1': False}
+
+    def test_unmatched_image_skips(self):
+        policy = _policy({
+            'imageReferences': ['quay.io/*'],
+            'attestors': [{'entries': [{'keys': {'publicKeys': 'key-1'}}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.SKIP]
+
+    def test_attestor_count_m_of_n(self):
+        registry = _registry()
+        registry.sign('ghcr.io/org/app', 'key-2')
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestors': [{'count': 1, 'entries': [
+                {'keys': {'publicKeys': 'nope'}},
+                {'keys': {'publicKeys': 'key-2'}}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, registry)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.PASS]
+
+    def test_attestation_conditions(self):
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestations': [{
+                'predicateType': 'https://slsa.dev/provenance/v0.2',
+                'conditions': [{'all': [{
+                    'key': '{{ builder.id }}',
+                    'operator': 'Equals',
+                    'value': 'github-actions'}]}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.PASS], resp.policy_response.rules
+
+    def test_attestation_condition_mismatch_fails(self):
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestations': [{
+                'predicateType': 'https://slsa.dev/provenance/v0.2',
+                'conditions': [{'all': [{
+                    'key': '{{ builder.id }}',
+                    'operator': 'Equals',
+                    'value': 'jenkins'}]}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.FAIL]
+
+    def test_missing_predicate_type_fails(self):
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestations': [{
+                'predicateType': 'https://example.com/unknown',
+            }]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.FAIL]
+
+    def test_legacy_image_key_form(self):
+        policy = _policy({'image': 'ghcr.io/org/*', 'key': 'key-1'})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.PASS]
+
+    def test_previously_verified_annotation_skips(self):
+        ann = {IMAGE_VERIFY_ANNOTATION:
+               json.dumps({'ghcr.io/org/app:v1': True})}
+        policy = _policy({
+            'imageReferences': ['ghcr.io/org/*'],
+            'attestors': [{'entries': [{'keys': {'publicKeys': 'nope'}}]}]})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1', ann))
+        resp, _ = Engine().verify_and_patch_images(pctx, _registry())
+        # previously verified: no rule response emitted for the image
+        assert resp.policy_response.rules == []
+
+
+class TestValidateMode:
+    def test_audit_checks_annotation(self):
+        policy = _policy({'imageReferences': ['ghcr.io/org/*'],
+                          'required': True, 'verifyDigest': False})
+        pod = _pod(f'ghcr.io/org/app:v1')
+        pctx = PolicyContext(policy=policy, new_resource=pod)
+        resp = Engine().validate(pctx)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.FAIL]
+
+    def test_audit_passes_with_annotation(self):
+        ann = {IMAGE_VERIFY_ANNOTATION:
+               json.dumps({'ghcr.io/org/app:v1': True})}
+        policy = _policy({'imageReferences': ['ghcr.io/org/*'],
+                          'required': True, 'verifyDigest': False})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1', ann))
+        resp = Engine().validate(pctx)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.PASS]
+
+    def test_verify_digest_fails_without_digest(self):
+        policy = _policy({'imageReferences': ['ghcr.io/org/*'],
+                          'required': False, 'verifyDigest': True})
+        pctx = PolicyContext(policy=policy,
+                             new_resource=_pod('ghcr.io/org/app:v1'))
+        resp = Engine().validate(pctx)
+        assert [r.status for r in resp.policy_response.rules] == \
+            [RuleStatus.FAIL]
+        assert 'missing digest' in resp.policy_response.rules[0].message
+
+
+class TestIVM:
+    def test_annotation_patches(self):
+        ivm = ImageVerificationMetadata({'img:1': True})
+        patches = ivm.annotation_patches({'metadata': {}})
+        assert patches[0] == {'op': 'add', 'path': '/metadata/annotations',
+                              'value': {}}
+        assert patches[1]['path'] == \
+            '/metadata/annotations/kyverno.io~1verify-images'
