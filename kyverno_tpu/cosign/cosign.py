@@ -72,11 +72,8 @@ def _signature_matches(sig: dict, opts: Options) -> bool:
 
 def verify_signature(rclient, opts: Options) -> Response:
     """reference: cosign.go:63 VerifySignature — raises on no match."""
-    try:
-        signatures = rclient.get_signatures(opts.image_ref)
-        digest = rclient.fetch_image_descriptor(opts.image_ref).digest
-    except RegistryError as err:
-        raise err
+    signatures = rclient.get_signatures(opts.image_ref)
+    digest = rclient.fetch_image_descriptor(opts.image_ref).digest
     for sig in signatures:
         if _signature_matches(sig, opts):
             return Response(digest=digest)
@@ -87,11 +84,8 @@ def verify_signature(rclient, opts: Options) -> Response:
 def fetch_attestations(rclient, opts: Options) -> Response:
     """reference: cosign.go:256 FetchAttestations — returns the in-toto
     statements whose signer matches the attestor options."""
-    try:
-        attestations = rclient.get_attestations(opts.image_ref)
-        digest = rclient.fetch_image_descriptor(opts.image_ref).digest
-    except RegistryError as err:
-        raise err
+    attestations = rclient.get_attestations(opts.image_ref)
+    digest = rclient.fetch_image_descriptor(opts.image_ref).digest
     statements = []
     for att in attestations:
         sig = {'key': att.get('key', ''), 'subject': att.get('subject', ''),

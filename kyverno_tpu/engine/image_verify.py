@@ -480,6 +480,12 @@ def verify_and_patch_images(engine, pctx: PolicyContext, rclient
                     f'failed to substitute variables: {exc}',
                     RuleStatus.ERROR))
                 continue
+            if rclient is None:
+                resp.policy_response.rules.append(RuleResponse(
+                    rule.name, RuleType.IMAGE_VERIFY,
+                    'image verification requires a registry client',
+                    RuleStatus.ERROR))
+                continue
             verifier = ImageVerifier(rclient, pctx, substituted, resp, ivm)
             for image_verify in substituted.verify_images:
                 verifier.verify(image_verify, matched)

@@ -1,0 +1,166 @@
+"""Report CR builders and labels (reference: pkg/utils/report/{new,labels}.go,
+api/kyverno/v1alpha2, api/policyreport/v1alpha2).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+from typing import List, Optional
+
+from ..api.policy import Policy
+
+LABEL_RESOURCE_HASH = 'audit.kyverno.io/resource.hash'
+LABEL_RESOURCE_UID = 'audit.kyverno.io/resource.uid'
+LABEL_DOMAIN_CLUSTER_POLICY = 'cpol.kyverno.io'
+LABEL_DOMAIN_POLICY = 'pol.kyverno.io'
+LABEL_AGGREGATED_REPORT = 'audit.kyverno.io/report.aggregate'
+LABEL_APP_MANAGED_BY = 'app.kubernetes.io/managed-by'
+VALUE_KYVERNO_APP = 'kyverno'
+
+
+def policy_label(policy: Policy) -> str:
+    """reference: labels.go:61 PolicyLabel"""
+    domain = LABEL_DOMAIN_POLICY if policy.is_namespaced \
+        else LABEL_DOMAIN_CLUSTER_POLICY
+    return f'{domain}/{policy.name}'
+
+
+def is_policy_label(label: str) -> bool:
+    """reference: labels.go:31 IsPolicyLabel"""
+    return label.startswith(f'{LABEL_DOMAIN_POLICY}/') or \
+        label.startswith(f'{LABEL_DOMAIN_CLUSTER_POLICY}/')
+
+
+def policy_name_from_label(namespace: str, label: str) -> str:
+    """reference: labels.go:35 PolicyNameFromLabel"""
+    parts = label.split('/')
+    if len(parts) == 2:
+        if parts[0] == LABEL_DOMAIN_CLUSTER_POLICY:
+            return parts[1]
+        if parts[0] == LABEL_DOMAIN_POLICY:
+            return f'{namespace}/{parts[1]}'
+    raise ValueError(
+        f'cannot get policy name from label, incorrect format: {label}')
+
+
+def _set_label(obj: dict, key: str, value: str) -> None:
+    obj.setdefault('metadata', {}).setdefault('labels', {})[key] = value
+
+
+def set_managed_by_kyverno_label(obj: dict) -> None:
+    _set_label(obj, LABEL_APP_MANAGED_BY, VALUE_KYVERNO_APP)
+
+
+def set_policy_label(report: dict, policy: Policy) -> None:
+    """reference: labels.go:100 SetPolicyLabel — value is the policy's
+    resourceVersion so report controllers detect stale results."""
+    _set_label(report, policy_label(policy),
+               policy.metadata.get('resourceVersion', '') or '')
+
+
+def set_resource_labels(report: dict, uid: str) -> None:
+    _set_label(report, LABEL_RESOURCE_UID, uid)
+
+
+def calculate_resource_hash(resource: dict) -> str:
+    """reference: labels.go:73 CalculateResourceHash — md5 over
+    [labels, annotations, object minus metadata/status/scale/nodeName]."""
+    obj = copy.deepcopy(resource)
+    meta = obj.get('metadata') or {}
+    labels = meta.get('labels')
+    annotations = meta.get('annotations')
+    obj.pop('metadata', None)
+    obj.pop('status', None)
+    obj.pop('scale', None)
+    if isinstance(obj.get('spec'), dict):
+        obj['spec'].pop('nodeName', None)
+    data = json.dumps([labels, annotations, obj], separators=(',', ':'),
+                      sort_keys=True)
+    return hashlib.md5(data.encode()).hexdigest()  # noqa: S324 — parity
+
+
+def set_resource_version_labels(report: dict,
+                                resource: Optional[dict]) -> None:
+    _set_label(report, LABEL_RESOURCE_HASH,
+               calculate_resource_hash(resource) if resource else '')
+
+
+def _owner_reference(resource: dict) -> dict:
+    meta = resource.get('metadata') or {}
+    return {
+        'apiVersion': resource.get('apiVersion', ''),
+        'kind': resource.get('kind', ''),
+        'name': meta.get('name', ''),
+        'uid': meta.get('uid', ''),
+    }
+
+
+def new_admission_report(namespace: str, name: str, owner_resource: dict
+                         ) -> dict:
+    """reference: new.go:15 NewAdmissionReport"""
+    kind = 'AdmissionReport' if namespace else 'ClusterAdmissionReport'
+    report = {
+        'apiVersion': 'kyverno.io/v1alpha2',
+        'kind': kind,
+        'metadata': {
+            'name': name,
+            'ownerReferences': [_owner_reference(owner_resource)],
+        },
+        'spec': {'owner': _owner_reference(owner_resource)},
+    }
+    if namespace:
+        report['metadata']['namespace'] = namespace
+    uid = (owner_resource.get('metadata') or {}).get('uid', '')
+    set_resource_labels(report, uid)
+    set_managed_by_kyverno_label(report)
+    return report
+
+
+def build_admission_report(resource: dict, request: dict,
+                           *responses, now: Optional[int] = None) -> dict:
+    """reference: new.go:35 BuildAdmissionReport"""
+    from .results import set_responses
+    meta = resource.get('metadata') or {}
+    report = new_admission_report(meta.get('namespace', ''),
+                                  str(request.get('uid', '')), resource)
+    set_responses(report, *responses, now=now)
+    return report
+
+
+def new_background_scan_report(resource: dict) -> dict:
+    """reference: new.go:42 NewBackgroundScanReport"""
+    meta = resource.get('metadata') or {}
+    namespace = meta.get('namespace', '')
+    kind = 'BackgroundScanReport' if namespace else \
+        'ClusterBackgroundScanReport'
+    report = {
+        'apiVersion': 'kyverno.io/v1alpha2',
+        'kind': kind,
+        'metadata': {
+            'name': meta.get('uid', '') or meta.get('name', ''),
+            'ownerReferences': [_owner_reference(resource)],
+        },
+    }
+    if namespace:
+        report['metadata']['namespace'] = namespace
+    set_managed_by_kyverno_label(report)
+    return report
+
+
+def new_policy_report(namespace: str, name: str,
+                      results: Optional[List[dict]] = None) -> dict:
+    """reference: new.go:57 NewPolicyReport"""
+    from .results import set_results
+    kind = 'PolicyReport' if namespace else 'ClusterPolicyReport'
+    report = {
+        'apiVersion': 'wgpolicyk8s.io/v1alpha2',
+        'kind': kind,
+        'metadata': {'name': name},
+    }
+    if namespace:
+        report['metadata']['namespace'] = namespace
+    set_managed_by_kyverno_label(report)
+    set_results(report, results or [])
+    return report

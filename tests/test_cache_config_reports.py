@@ -1,0 +1,248 @@
+"""Policy cache, dynamic config, and report pipeline tests
+(reference behavior: pkg/policycache/cache_test.go,
+pkg/config/config.go, pkg/utils/report, report aggregate controller)."""
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.config import ConfigController, Configuration
+from kyverno_tpu.dclient import FakeClient
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.policycache import (
+    GENERATE, MUTATE, VALIDATE_AUDIT, VALIDATE_ENFORCE, Cache,
+)
+from kyverno_tpu.reports import (
+    AggregateController, build_admission_report,
+    engine_response_to_report_results, new_background_scan_report,
+)
+from kyverno_tpu.reports.results import set_responses
+
+
+def _policy(name, kinds=('Pod',), action='Audit', rule_type='validate',
+            namespace='', overrides=None):
+    rule = {'name': 'r', 'match': {'any': [{'resources':
+                                            {'kinds': list(kinds)}}]}}
+    if rule_type == 'validate':
+        rule['validate'] = {'pattern': {'spec': {'x': '?*'}}}
+    elif rule_type == 'mutate':
+        rule['mutate'] = {'patchStrategicMerge': {'metadata': {
+            'labels': {'a': 'b'}}}}
+    elif rule_type == 'generate':
+        rule['generate'] = {'kind': 'ConfigMap', 'name': 'x',
+                            'namespace': 'default', 'data': {}}
+    raw = {'apiVersion': 'kyverno.io/v1',
+           'kind': 'Policy' if namespace else 'ClusterPolicy',
+           'metadata': {'name': name,
+                        'annotations': {
+                            'pod-policies.kyverno.io/autogen-controllers':
+                            'none'}},
+           'spec': {'rules': [rule],
+                    'validationFailureAction': action}}
+    if namespace:
+        raw['metadata']['namespace'] = namespace
+    if overrides:
+        raw['spec']['validationFailureActionOverrides'] = overrides
+    return Policy(raw)
+
+
+class TestPolicyCache:
+    def test_type_index(self):
+        cache = Cache()
+        cache.set('audit-pol', _policy('audit-pol', action='Audit'))
+        cache.set('enforce-pol', _policy('enforce-pol', action='Enforce'))
+        cache.set('mut', _policy('mut', rule_type='mutate'))
+        cache.set('gen', _policy('gen', rule_type='generate'))
+        # enforce policies join the audit candidate list (cache.go:47) but
+        # are filtered back out unless an override makes them audit in ns
+        audit = [p.name for p in cache.get_policies(VALIDATE_AUDIT, 'Pod')]
+        assert set(audit) == {'audit-pol'}
+        enforce = [p.name for p in cache.get_policies(VALIDATE_ENFORCE, 'Pod')]
+        assert enforce == ['enforce-pol']
+        assert [p.name for p in cache.get_policies(MUTATE, 'Pod')] == ['mut']
+        assert [p.name for p in cache.get_policies(GENERATE, 'Pod')] == ['gen']
+        assert cache.get_policies(MUTATE, 'Service') == []
+
+    def test_namespace_override_filtering(self):
+        cache = Cache()
+        cache.set('p', _policy(
+            'p', action='Audit',
+            overrides=[{'action': 'Enforce', 'namespaces': ['prod-*']}]))
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_ENFORCE, 'Pod', 'prod-eu')] == ['p']
+        # in the override'd namespace the audit lookup drops the policy
+        assert cache.get_policies(VALIDATE_AUDIT, 'Pod', 'prod-eu') == []
+        # elsewhere the base Audit action applies
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_AUDIT, 'Pod', 'dev')] == ['p']
+
+    def test_enforce_policy_with_audit_override_in_ns(self):
+        cache = Cache()
+        cache.set('e', _policy(
+            'e', action='Enforce',
+            overrides=[{'action': 'Audit', 'namespaces': ['sandbox']}]))
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_AUDIT, 'Pod', 'sandbox')] == ['e']
+        assert cache.get_policies(VALIDATE_ENFORCE, 'Pod', 'sandbox') == []
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_ENFORCE, 'Pod', 'prod')] == ['e']
+
+    def test_namespaced_policy_scoping(self):
+        cache = Cache()
+        cache.set('team-a/p', _policy('p', namespace='team-a'))
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_AUDIT, 'Pod', 'team-a')] == ['p']
+        assert cache.get_policies(VALIDATE_AUDIT, 'Pod', 'team-b') == []
+        assert cache.get_policies(VALIDATE_AUDIT, 'Pod', '') == []
+
+    def test_unset(self):
+        cache = Cache()
+        cache.set('p', _policy('p'))
+        cache.unset('p')
+        assert cache.get_policies(VALIDATE_AUDIT, 'Pod') == []
+
+    def test_wildcard_kind(self):
+        cache = Cache()
+        cache.set('w', _policy('w', kinds=['*']))
+        assert [p.name for p in
+                cache.get_policies(VALIDATE_AUDIT, 'Secret')] == ['w']
+
+
+class TestConfiguration:
+    def test_defaults(self):
+        cfg = Configuration()
+        assert cfg.get_default_registry() == 'docker.io'
+        assert 'system:nodes' in cfg.get_exclude_group_role()
+        assert not cfg.to_filter('Pod', 'default', 'x')
+
+    def test_load_and_filter(self):
+        cfg = Configuration()
+        cfg.load({'data': {
+            'resourceFilters':
+                '[Event,*,*][*,kube-system,*][Secret,*,no-scan-*]',
+            'excludeGroupRole': 'system:custom',
+            'excludeUsername': 'admin,ci-bot',
+            'defaultRegistry': 'registry.example.com:5000',
+            'generateSuccessEvents': 'true',
+        }})
+        assert cfg.to_filter('Event', 'default', 'e1')
+        assert cfg.to_filter('Pod', 'kube-system', 'p')
+        assert cfg.to_filter('Secret', 'app', 'no-scan-1')
+        assert not cfg.to_filter('Secret', 'app', 'scan-me')
+        assert 'system:custom' in cfg.get_exclude_group_role()
+        assert 'system:nodes' in cfg.get_exclude_group_role()
+        assert cfg.get_exclude_username() == ['admin', 'ci-bot']
+        assert cfg.get_default_registry() == 'registry.example.com:5000'
+        assert cfg.get_generate_success_events()
+
+    def test_hot_reload_via_controller(self):
+        client = FakeClient()
+        cfg = Configuration()
+        ConfigController(client, cfg)
+        client.create_resource('v1', 'ConfigMap', 'kyverno', {
+            'apiVersion': 'v1', 'kind': 'ConfigMap',
+            'metadata': {'name': 'kyverno', 'namespace': 'kyverno'},
+            'data': {'resourceFilters': '[Node,*,*]'}})
+        assert cfg.to_filter('Node', '', 'n1')
+        client.delete_resource('v1', 'ConfigMap', 'kyverno', 'kyverno')
+        assert not cfg.to_filter('Node', '', 'n1')
+
+
+def _engine_response(policy, resource):
+    return Engine().validate(PolicyContext(policy=policy,
+                                           new_resource=resource))
+
+
+def _pod(name='p', namespace='default', uid='uid-1', compliant=False):
+    spec = {'containers': [{'name': 'c', 'image': 'nginx:1'}]}
+    if compliant:
+        spec['x'] = 'ok'
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': namespace, 'uid': uid},
+            'spec': spec}
+
+
+class TestReportResults:
+    def test_mapping_fields(self):
+        policy = _policy('check')
+        resp = _engine_response(policy, _pod())
+        results = engine_response_to_report_results(resp, now=1234)
+        assert len(results) == 1
+        r = results[0]
+        assert r['source'] == 'kyverno'
+        assert r['policy'] == 'check'
+        assert r['rule'] == 'r'
+        assert r['result'] == 'fail'
+        assert r['scored'] is True
+        assert r['timestamp'] == {'seconds': 1234}
+
+    def test_unscored_fail_becomes_warn(self):
+        policy = _policy('check')
+        policy.raw['metadata']['annotations'][
+            'policies.kyverno.io/scored'] = 'false'
+        resp = _engine_response(policy, _pod())
+        results = engine_response_to_report_results(resp, now=1)
+        assert results[0]['result'] == 'warn'
+        assert results[0]['scored'] is False
+
+    def test_admission_report_builder(self):
+        policy = _policy('check')
+        pod = _pod()
+        resp = _engine_response(policy, pod)
+        report = build_admission_report(
+            pod, {'uid': 'req-1'}, resp, now=1)
+        assert report['kind'] == 'AdmissionReport'
+        assert report['metadata']['name'] == 'req-1'
+        assert report['summary'] == {'pass': 0, 'fail': 1, 'warn': 0,
+                                     'error': 0, 'skip': 0}
+        assert report['metadata']['labels'][
+            'audit.kyverno.io/resource.uid'] == 'uid-1'
+
+
+class TestAggregation:
+    def _store_scan_report(self, client, policy, pod, now):
+        report = new_background_scan_report(pod)
+        resp = _engine_response(policy, pod)
+        set_responses(report, resp, now=now)
+        client.create_resource('kyverno.io/v1alpha2', report['kind'],
+                               (pod['metadata'].get('namespace', '')), report)
+
+    def test_merge_to_policy_report(self):
+        client = FakeClient()
+        policy = _policy('check')
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               policy.raw)
+        pod1 = _pod('p1', uid='u1')
+        pod2 = _pod('p2', uid='u2', compliant=True)
+        self._store_scan_report(client, policy, pod1, now=10)
+        self._store_scan_report(client, policy, pod2, now=10)
+        ctrl = AggregateController(client)
+        reports = ctrl.reconcile()
+        assert len(reports) == 1
+        pr = reports[0]
+        assert pr['kind'] == 'PolicyReport'
+        assert pr['metadata']['name'] == 'cpol-check'
+        assert pr['summary']['fail'] == 1 and pr['summary']['pass'] == 1
+        uids = {r['resources'][0]['uid'] for r in pr['results']}
+        assert uids == {'u1', 'u2'}
+
+    def test_newest_result_wins_and_stale_policies_dropped(self):
+        client = FakeClient()
+        policy = _policy('check')
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               policy.raw)
+        pod = _pod('p1', uid='u1')
+        self._store_scan_report(client, policy, pod, now=10)
+        # newer admission report for the same resource: compliant now
+        resp = _engine_response(policy, _pod('p1', uid='u1', compliant=True))
+        report = build_admission_report(pod, {'uid': 'r1'}, resp, now=20)
+        client.create_resource('kyverno.io/v1alpha2', 'AdmissionReport',
+                               'default', report)
+        ctrl = AggregateController(client)
+        reports = ctrl.reconcile()
+        assert reports[0]['summary'] == {'pass': 1, 'fail': 0, 'warn': 0,
+                                         'error': 0, 'skip': 0}
+        # deleting the policy removes its results and the report cleans up
+        client.delete_resource('kyverno.io/v1', 'ClusterPolicy', '', 'check')
+        reports = ctrl.reconcile()
+        assert all(not r.get('results') for r in reports)
