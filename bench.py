@@ -11,11 +11,19 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import random
 import sys
 import time
 
 sys.path.insert(0, '.')
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+if os.environ.get('JAX_PLATFORMS') == 'cpu':
+    # the ambient axon sitecustomize pins the TPU plugin; the env var alone
+    # is not enough to force CPU — override the jax config directly
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
 
 from kyverno_tpu.api.policy import load_policies_from_yaml  # noqa: E402
 from kyverno_tpu.compiler.scan import BatchScanner  # noqa: E402

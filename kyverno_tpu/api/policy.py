@@ -170,14 +170,22 @@ def load_policies_from_yaml(text: str) -> List[Policy]:
     for doc in yaml.safe_load_all(text):
         if not isinstance(doc, dict):
             continue
-        kind = doc.get('kind')
-        if kind in ('ClusterPolicy', 'Policy'):
+        if is_kyverno_policy(doc):
             out.append(Policy(doc))
-        elif kind == 'List':
+        elif doc.get('kind') == 'List':
             for item in doc.get('items') or []:
-                if isinstance(item, dict) and item.get('kind') in ('ClusterPolicy', 'Policy'):
+                if isinstance(item, dict) and is_kyverno_policy(item):
                     out.append(Policy(item))
     return out
+
+
+def is_kyverno_policy(doc: dict) -> bool:
+    """True only for kyverno.io Policy/ClusterPolicy — other API groups
+    also use the kind name ``Policy`` (e.g. config.kio.kasten.io)."""
+    if doc.get('kind') not in ('ClusterPolicy', 'Policy'):
+        return False
+    api_version = doc.get('apiVersion') or 'kyverno.io/v1'
+    return api_version.startswith('kyverno.io/')
 
 
 def load_resources_from_yaml(text: str) -> List[dict]:

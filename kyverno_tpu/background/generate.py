@@ -136,7 +136,9 @@ class GenerateController:
             ctx = pctx.json_context
             ctx.checkpoint()
             try:
-                self.engine.context_loader.load(rule.context, ctx)
+                self.engine.context_loader.load(rule.context, ctx,
+                                                policy_name=policy.name,
+                                                rule_name=rule.name)
                 substituted = Rule(substitute_all(ctx, copy.deepcopy(raw_rule)))
                 created = self._apply_rule(substituted, pctx.new_resource,
                                            policy, ur)
@@ -428,3 +430,52 @@ def _subset_matches(existing: dict, desired: dict) -> bool:
             return False
         return all(_subset_matches(e, d) for e, d in zip(existing, desired))
     return existing == desired
+
+
+def materialize_rule_offline(raw_rule: dict, pctx,
+                             clone_source: Optional[dict] = None
+                             ) -> Optional[dict]:
+    """Materialize one generate rule's target without a cluster — the CLI
+    `test`/`apply` path (reference: cmd/cli/kubectl-kyverno/utils/common/
+    generate.go handleGeneratePolicy, which runs the generate controller
+    against a fake client seeded with CloneSourceResource)."""
+    ctx = pctx.json_context
+    ctx.checkpoint()
+    try:
+        rule = Rule(substitute_all(ctx, copy.deepcopy(raw_rule)))
+    finally:
+        ctx.restore()
+    gen = rule.generation
+    kind = gen.get('kind', '')
+    name = gen.get('name', '')
+    namespace = gen.get('namespace', '')
+    api_version = gen.get('apiVersion', '')
+    clone = gen.get('clone') or {}
+    if clone.get('name'):
+        if clone_source is None:
+            raise ValueError(
+                f'no clone source for generate rule {rule.name}')
+        data = copy.deepcopy(clone_source)
+        (data.get('metadata') or {}).pop('creationTimestamp', None)
+        (data.get('metadata') or {}).pop('resourceVersion', None)
+        (data.get('metadata') or {}).pop('uid', None)
+    elif gen.get('data') is not None:
+        data = copy.deepcopy(gen.get('data')) or {}
+    elif (gen.get('cloneList') or {}).get('kinds'):
+        raise ValueError(
+            f'generate rule {rule.name} uses cloneList, which needs cluster '
+            'access; provide cloneSourceResource per target instead')
+    else:
+        return None
+    meta = data.setdefault('metadata', {})
+    meta['name'] = name
+    if namespace:
+        meta['namespace'] = namespace
+    else:
+        meta.pop('namespace', None)
+    if not data.get('kind'):
+        data['kind'] = kind
+    if api_version and not data.get('apiVersion'):
+        data['apiVersion'] = api_version
+    manage_labels(data, pctx.new_resource)
+    return data

@@ -122,9 +122,13 @@ class Configuration:
                 data.get('excludeUsername', ''))
             self._generate_success_events = \
                 data.get('generateSuccessEvents', '').lower() == 'true'
+            # reset to defaults first so removed/invalid keys revert
+            # (reference: pkg/config/config.go load)
+            self._default_registry = 'docker.io'
             registry = data.get('defaultRegistry')
             if registry and _DNS_RE.match(registry):
                 self._default_registry = registry
+            self._enable_default_registry_mutation = True
             mutation = data.get('enableDefaultRegistryMutation')
             if mutation is not None:
                 if mutation.lower() in ('true', 'false'):

@@ -153,7 +153,8 @@ class PolicyContext:
                  exceptions: Optional[List[dict]] = None,
                  admission_operation: str = '',
                  subresource: str = '',
-                 element: Optional[dict] = None):
+                 element: Optional[dict] = None,
+                 subresources_in_policy: Optional[List[dict]] = None):
         self.policy = policy
         self.new_resource = new_resource or {}
         self.old_resource = old_resource or {}
@@ -164,6 +165,9 @@ class PolicyContext:
         self.admission_operation = admission_operation
         self.subresource = subresource
         self.element = element
+        # CLI-only: subresource declarations from the values file
+        # (reference: pkg/engine/policyContext.go WithSubresourcesInPolicy)
+        self.subresources_in_policy = subresources_in_policy or []
         if json_context is None:
             json_context = Context()
             if self.new_resource:
@@ -186,6 +190,7 @@ class PolicyContext:
         c.admission_operation = self.admission_operation
         c.subresource = self.subresource
         c.element = self.element
+        c.subresources_in_policy = self.subresources_in_policy
         c.json_context = self.json_context
         return c
 

@@ -94,7 +94,9 @@ def _filter_rule(engine, rule: Rule,
     ctx.checkpoint()
     try:
         try:
-            engine.context_loader.load(rule.context, ctx)
+            engine.context_loader.load(rule.context, ctx,
+                                       policy_name=pctx.policy.name,
+                                       rule_name=rule.name)
         except Exception:
             return None
         try:

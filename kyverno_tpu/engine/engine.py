@@ -43,7 +43,12 @@ class ContextLoader:
         self.api_call = api_call
         self.image_data = image_data
 
-    def load(self, entries: List[dict], ctx: Context) -> None:
+    def load(self, entries: List[dict], ctx: Context,
+             policy_name: str = '', rule_name: str = '') -> None:
+        """``policy_name``/``rule_name`` identify the calling rule so mock
+        loaders (CLI values files, reference: pkg/engine/jsonContext.go:88)
+        can inject per-rule variables; the real loader ignores them."""
+        del policy_name, rule_name
         for entry in entries:
             name = entry.get('name', '')
             if entry.get('configMap') is not None:
@@ -252,7 +257,6 @@ class Engine:
         has_validate_image = any(
             iv.get('verifyDigest', True) or iv.get('required', True)
             for iv in rule.verify_images)
-        has_manifests = bool(rule.validation.get('manifests'))
         if not has_validate and not has_validate_image:
             return None
         if not self._matches(rule, pctx):
@@ -261,12 +265,11 @@ class Engine:
         if exception_resp is not None:
             return exception_resp
         pctx.json_context.reset()
-        if has_validate and not has_manifests:
+        if has_validate:
+            # manifests rules also flow through Validator so context
+            # loading and preconditions run first
+            # (reference: pkg/engine/validation.go:185)
             return Validator(self, pctx, rule).validate()
-        if has_manifests:
-            return RuleResponse(rule.name, RuleType.VALIDATION,
-                                'manifest verification requires signatures',
-                                RuleStatus.ERROR)
         if has_validate_image:
             from .image_verify import process_image_validation_rule
             return process_image_validation_rule(self, pctx, rule)
@@ -277,14 +280,14 @@ class Engine:
         err = matches_resource_description(
             Resource(pctx.new_resource), rule, pctx.admission_info,
             pctx.exclude_group_roles, pctx.namespace_labels, '',
-            pctx.subresource)
+            pctx.subresource, pctx.subresources_in_policy)
         if err is None:
             return True
         if pctx.old_resource:
             err = matches_resource_description(
                 Resource(pctx.old_resource), rule, pctx.admission_info,
                 pctx.exclude_group_roles, pctx.namespace_labels, '',
-                pctx.subresource)
+                pctx.subresource, pctx.subresources_in_policy)
             if err is None:
                 return True
         return False
@@ -358,6 +361,7 @@ class Validator:
             self.any_pattern = v.get('anyPattern')
             self.deny = v.get('deny')
             self.pod_security = v.get('podSecurity')
+            self.manifests = v.get('manifests')
             self.foreach = v.get('foreach')
         else:
             self.context_entries = foreach_entry.get('context') or []
@@ -366,6 +370,7 @@ class Validator:
             self.any_pattern = foreach_entry.get('anyPattern')
             self.deny = foreach_entry.get('deny')
             self.pod_security = None
+            self.manifests = None
             self.foreach = foreach_entry.get('foreach')
 
     # -- entry ---------------------------------------------------------------
@@ -373,8 +378,9 @@ class Validator:
     def validate(self) -> Optional[RuleResponse]:
         # reference: pkg/engine/validation.go:276 validate
         try:
-            self.engine.context_loader.load(self.context_entries,
-                                            self.pctx.json_context)
+            self.engine.context_loader.load(
+                self.context_entries, self.pctx.json_context,
+                policy_name=self.pctx.policy.name, rule_name=self.rule.name)
         except (ContextError, SubstitutionError, InvalidVariableError) as e:
             return _rule_error(self.rule, RuleType.VALIDATION,
                                'failed to load context', e)
@@ -398,6 +404,10 @@ class Validator:
         if self.pod_security is not None:
             if not self._is_delete_request():
                 return self._validate_pod_security()
+        if self.manifests is not None:
+            # reference: pkg/engine/validation.go processYAMLValidationRule
+            from .k8smanifest import process_yaml_validation_rule
+            return process_yaml_validation_rule(self.pctx, self.rule)
         if self.foreach is not None:
             return self._validate_foreach()
         return None

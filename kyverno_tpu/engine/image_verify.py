@@ -466,7 +466,9 @@ def verify_and_patch_images(engine, pctx: PolicyContext, rclient
                 continue
             ctx.reset()
             try:
-                engine.context_loader.load(rule.context, ctx)
+                engine.context_loader.load(rule.context, ctx,
+                                           policy_name=pctx.policy.name,
+                                           rule_name=rule.name)
             except Exception as exc:  # noqa: BLE001
                 resp.policy_response.rules.append(RuleResponse(
                     rule.name, RuleType.IMAGE_VERIFY,
@@ -551,7 +553,9 @@ def process_image_validation_rule(engine, pctx: PolicyContext,
                             RuleStatus.SKIP)
     ctx = pctx.json_context
     try:
-        engine.context_loader.load(rule.context, ctx)
+        engine.context_loader.load(rule.context, ctx,
+                                   policy_name=pctx.policy.name,
+                                   rule_name=rule.name)
     except Exception as exc:  # noqa: BLE001
         return RuleResponse(rule.name, RuleType.VALIDATION,
                             f'failed to load context: {exc}',

@@ -27,7 +27,8 @@ def matches_resource_description(resource: Resource, rule, admission_info: Optio
                                  exclude_group_roles: List[str],
                                  namespace_labels: Dict[str, str],
                                  policy_namespace: str,
-                                 subresource_in_review: str = '') -> Optional[str]:
+                                 subresource_in_review: str = '',
+                                 subresources_in_policy: Optional[List[dict]] = None) -> Optional[str]:
     """Return None if the rule matches, else a reason string
     (reference: pkg/engine/utils.go:185 MatchesResourceDescription)."""
     if policy_namespace and policy_namespace != resource.namespace:
@@ -43,12 +44,14 @@ def matches_resource_description(resource: Resource, rule, admission_info: Optio
     def match_filter(f):
         return _check_filter(f, resource, admission_info, exclude_group_roles,
                              namespace_labels, subresource_in_review,
-                             allow_ephemeral=True, mode='match')
+                             allow_ephemeral=True, mode='match',
+                             subresources_in_policy=subresources_in_policy)
 
     def exclude_filter(f):
         return _check_filter(f, resource, admission_info, exclude_group_roles,
                              namespace_labels, subresource_in_review,
-                             allow_ephemeral=True, mode='exclude')
+                             allow_ephemeral=True, mode='exclude',
+                             subresources_in_policy=subresources_in_policy)
 
     any_filters = match.get('any') or []
     all_filters = match.get('all') or []
@@ -99,7 +102,8 @@ def _check_filter(f: dict, resource: Resource, admission_info: Optional[dict],
                   namespace_labels: Dict[str, str],
                   subresource_in_review: str,
                   allow_ephemeral: bool = False,
-                  mode: str = 'match') -> List[str]:
+                  mode: str = 'match',
+                  subresources_in_policy: Optional[List[dict]] = None) -> List[str]:
     """Return list of mismatch reasons (empty == filter matched).
 
     ``mode='match'`` mirrors matchesResourceDescriptionMatchHelper
@@ -118,7 +122,7 @@ def _check_filter(f: dict, resource: Resource, admission_info: Optional[dict],
     if res_desc or has_user_info:
         errs.extend(_check_resource_description(
             res_desc, resource, namespace_labels, subresource_in_review,
-            allow_ephemeral))
+            allow_ephemeral, subresources_in_policy))
         if has_user_info:
             errs.extend(_check_user_info(user_info, admission_info or {},
                                          exclude_group_roles))
@@ -132,12 +136,14 @@ def _check_filter(f: dict, resource: Resource, admission_info: Optional[dict],
 def _check_resource_description(block: dict, resource: Resource,
                                 namespace_labels: Dict[str, str],
                                 subresource_in_review: str,
-                                allow_ephemeral: bool) -> List[str]:
+                                allow_ephemeral: bool,
+                                subresources_in_policy: Optional[List[dict]] = None) -> List[str]:
     # reference: pkg/engine/utils.go:72 doesResourceMatchConditionBlock
     errs: List[str] = []
     kinds = block.get('kinds') or []
     if kinds:
-        if not check_kind(kinds, resource, subresource_in_review, allow_ephemeral):
+        if not check_kind(kinds, resource, subresource_in_review,
+                          allow_ephemeral, subresources_in_policy):
             errs.append(f'kind does not match {kinds}')
     resource_name = resource.name or resource.generate_name
     name = block.get('name') or ''
@@ -179,13 +185,25 @@ def _check_namespaces(namespaces: List[str], resource: Resource) -> bool:
 
 def check_kind(kinds: List[str], resource: Resource,
                subresource_in_review: str = '',
-               allow_ephemeral: bool = False) -> bool:
+               allow_ephemeral: bool = False,
+               subresources_in_policy: Optional[List[dict]] = None) -> bool:
     """Kind matching incl. group/version prefixes and subresources
-    (reference: pkg/utils/match/kind.go:14 CheckKind)."""
+    (reference: pkg/utils/match/kind.go:14 CheckKind; the subresource
+    lookup map is built per-policy from CLI values when there is no
+    cluster, reference: pkg/engine/common.go:12
+    GetSubresourceGVKToAPIResourceMap)."""
     for k in kinds:
         if k == '*':
             return True
         gv, kind = get_kind_from_gvk(k)
+        api_resource = _subresource_api_resource(k, subresources_in_policy)
+        if api_resource is not None:
+            if (api_resource.get('group', '') == resource.group and
+                    (api_resource.get('version', '') == resource.version or
+                     '*' in gv) and
+                    api_resource.get('kind', '') == resource.kind):
+                return True
+            continue
         result = kind == resource.kind and (
             subresource_in_review == '' or
             (allow_ephemeral and subresource_in_review == 'ephemeralcontainers'))
@@ -194,6 +212,42 @@ def check_kind(kinds: List[str], resource: Resource,
         if result:
             return True
     return False
+
+
+def _subresource_api_resource(gvk_str: str,
+                              subresources_in_policy: Optional[List[dict]]
+                              ) -> Optional[dict]:
+    """reference: pkg/engine/common.go:12 — resolve a rule kind like
+    'Deployment/scale' or a standalone subresource kind like
+    'PodExecOptions' against the CLI-provided subresource list."""
+    if not subresources_in_policy:
+        return None
+    from ..api.unstructured import split_subresource
+    gv, k = get_kind_from_gvk(gvk_str)
+    parent_kind, subresource = split_subresource(k)
+    for entry in subresources_in_policy:
+        api_resource = entry.get('subresource') or entry.get('apiResource') or {}
+        parent = entry.get('parentResource') or {}
+        if subresource:
+            parent_gv = (f"{parent.get('group')}/{parent.get('version', '')}"
+                         if parent.get('group') else parent.get('version', ''))
+            if gv and not group_version_matches(gv, parent_gv):
+                continue
+            if parent_kind != parent.get('kind'):
+                continue
+            name_parts = (api_resource.get('name', '') or '').split('/')
+            if len(name_parts) > 1 and subresource.lower() == name_parts[1]:
+                return api_resource
+        else:
+            if (k == api_resource.get('kind') and
+                    k != parent.get('kind')):
+                sub_gv = (f"{api_resource.get('group')}/"
+                          f"{api_resource.get('version', '')}"
+                          if api_resource.get('group')
+                          else api_resource.get('version', ''))
+                if gv == '' or group_version_matches(gv, sub_gv):
+                    return api_resource
+    return None
 
 
 def check_annotations(expected: Dict[str, str], actual: Dict[str, str]) -> bool:

@@ -159,7 +159,9 @@ def mutate(engine, pctx: PolicyContext) -> EngineResponse:
             if isinstance(resource, dict):
                 pctx.json_context.add_resource(resource)
             try:
-                engine.context_loader.load(rule.context, pctx.json_context)
+                engine.context_loader.load(rule.context, pctx.json_context,
+                                           policy_name=pctx.policy.name,
+                                           rule_name=rule.name)
             except (ContextError, SubstitutionError, InvalidVariableError):
                 continue
 
@@ -236,8 +238,10 @@ class ForEachMutator:
         entries = foreach_list if foreach_list is not None else self.foreach
         for foreach in entries:
             try:
-                self.engine.context_loader.load(self.rule.context,
-                                                self.pctx.json_context)
+                self.engine.context_loader.load(
+                    self.rule.context, self.pctx.json_context,
+                    policy_name=self.pctx.policy.name,
+                    rule_name=self.rule.name)
             except (ContextError, SubstitutionError, InvalidVariableError) as e:
                 return _error_response('failed to load context', e)
             try:
@@ -282,7 +286,9 @@ class ForEachMutator:
                 ctx.add_element(element, index, self.nesting)
                 try:
                     self.engine.context_loader.load(
-                        foreach.get('context') or [], ctx)
+                        foreach.get('context') or [], ctx,
+                        policy_name=self.pctx.policy.name,
+                        rule_name=self.rule.name)
                 except (ContextError, SubstitutionError,
                         InvalidVariableError) as e:
                     return _error_response(

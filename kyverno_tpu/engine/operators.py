@@ -41,6 +41,11 @@ def evaluate_conditions(ctx, conditions: Any) -> bool:
     """Evaluate any/all condition blocks, supporting both the new
     AnyAllConditions form and the legacy list-of-conditions form
     (reference: pkg/engine/variables/evaluate.go:21)."""
+    if conditions is None:
+        # nil conditions transform to an empty AnyAllConditions block which
+        # evaluates vacuously true (reference: pkg/utils/conditions.go
+        # TransformConditions + evaluate.go:42) — deny: {} always denies
+        return True
     if isinstance(conditions, dict):
         return _evaluate_any_all(ctx, conditions)
     if isinstance(conditions, list):

@@ -17,7 +17,8 @@ from ..autogen.autogen import compute_rules
 from ..dclient.client import NotFoundError
 from .results import set_results
 from .types import (
-    new_policy_report, set_managed_by_kyverno_label, set_policy_label,
+    LABEL_APP_MANAGED_BY, VALUE_KYVERNO_APP, new_policy_report,
+    set_managed_by_kyverno_label, set_policy_label,
 )
 
 _SOURCE_KINDS = (
@@ -148,6 +149,12 @@ class AggregateController:
             for report in self.client.list_resource(
                     'wgpolicyk8s.io/v1alpha2', kind):
                 meta = report.get('metadata') or {}
+                labels = meta.get('labels') or {}
+                # only reap kyverno-managed reports — third-party
+                # PolicyReports (e.g. trivy-operator) are not ours
+                # (reference: aggregate/controller.go report selector)
+                if labels.get(LABEL_APP_MANAGED_BY) != VALUE_KYVERNO_APP:
+                    continue
                 key = (meta.get('namespace', ''), meta.get('name', ''))
                 if key not in keep:
                     try:
