@@ -124,13 +124,14 @@ def run_test_file(test_file: str,
     engine = Engine(context_loader=MockContextLoader(store))
     ns_map = values.namespace_selector_map()
 
-    # (policy, resource_name) -> ApplyResult
-    applied: Dict[Tuple[str, str, str], ApplyResult] = {}
+    # (policy, kind, namespace, resource_name) -> ApplyResult
+    applied: Dict[Tuple[str, str, str, str], ApplyResult] = {}
     for policy in policies:
         for resource in resources:
             meta = resource.get('metadata') or {}
             rname = meta.get('name', '')
             rkind = resource.get('kind', '')
+            rns = meta.get('namespace', '')
             variables = dict(values.global_values)
             variables.update(values.resource_values(policy.name, rname))
             result = apply_policy_on_resource(
@@ -138,7 +139,7 @@ def run_test_file(test_file: str,
                 user_info=user_info, namespace_selector_map=ns_map,
                 rule_to_clone_source=rule_to_clone_source,
                 subresources=values.subresources)
-            applied[(policy.name, rkind, rname)] = result
+            applied[(policy.name, rkind, rns, rname)] = result
 
     unscored = {p.name for p in policies
                 if (p.annotations or {}).get(
@@ -156,20 +157,22 @@ def run_test_file(test_file: str,
 
 
 def _match_resource(case: TestCase, target: str,
-                    applied: Dict[Tuple[str, str, str], ApplyResult]
+                    applied: Dict[Tuple[str, str, str, str], ApplyResult]
                     ) -> Optional[ApplyResult]:
-    if case.kind:
-        hit = applied.get((case.policy, case.kind, target))
-        if hit is not None:
-            return hit
-    for (pname, _kind, rname), result in applied.items():
-        if pname == case.policy and rname == target:
-            return result
-    return None
+    candidates = []
+    for (pname, kind, ns, rname), result in applied.items():
+        if pname != case.policy or rname != target:
+            continue
+        if case.kind and kind != case.kind:
+            continue
+        if case.namespace and ns not in (case.namespace, ''):
+            continue
+        candidates.append(result)
+    return candidates[0] if candidates else None
 
 
 def _actual_status(case: TestCase, target: str,
-                   applied: Dict[Tuple[str, str, str], ApplyResult],
+                   applied: Dict[Tuple[str, str, str, str], ApplyResult],
                    base: str) -> str:
     result = _match_resource(case, target, applied)
     if result is None:

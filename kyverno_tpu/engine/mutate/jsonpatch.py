@@ -59,18 +59,25 @@ def _resolve_parent(doc: Any, tokens: List[str]) -> Tuple[Any, str]:
 
 
 def apply_patch(doc: Any, operations: List[dict]) -> Any:
-    """Apply an RFC 6902 operation list, returning the patched document."""
+    """Apply an RFC 6902 operation list, returning the patched document.
+
+    Matches the reference's evanphx/json-patch ApplyOptions
+    (patchJSON6902.go:78): EnsurePathExistsOnAdd (add creates missing
+    intermediate containers), AllowMissingPathOnRemove (remove of a
+    missing path is a no-op), SupportNegativeIndices.
+    """
     doc = copy.deepcopy(doc)
     for op in operations:
         action = op.get('op')
         path = op.get('path', '')
         tokens = _split_pointer(path)
         if action == 'add':
-            doc = _op_add(doc, tokens, copy.deepcopy(op.get('value')))
+            doc = _op_add(doc, tokens, copy.deepcopy(op.get('value')),
+                          ensure_path=True)
         elif action == 'replace':
             doc = _op_replace(doc, tokens, copy.deepcopy(op.get('value')))
         elif action == 'remove':
-            doc = _op_remove(doc, tokens)
+            doc = _op_remove(doc, tokens, allow_missing=True)
         elif action == 'move':
             from_tokens = _split_pointer(op.get('from', ''))
             value = _get(doc, from_tokens)
@@ -88,9 +95,12 @@ def apply_patch(doc: Any, operations: List[dict]) -> Any:
     return doc
 
 
-def _op_add(doc: Any, tokens: List[str], value: Any) -> Any:
+def _op_add(doc: Any, tokens: List[str], value: Any,
+            ensure_path: bool = False) -> Any:
     if not tokens:
         return value
+    if ensure_path:
+        doc = _ensure_parents(doc, tokens)
     parent, last = _resolve_parent(doc, tokens)
     if isinstance(parent, dict):
         parent[last] = value
@@ -102,11 +112,48 @@ def _op_add(doc: Any, tokens: List[str], value: Any) -> Any:
                 idx = int(last)
             except ValueError:
                 raise JsonPatchError(f'invalid array index {last!r}')
+            if idx < 0:
+                idx += len(parent)  # SupportNegativeIndices
             if idx < 0 or idx > len(parent):
-                raise JsonPatchError(f'array index {idx} out of bounds')
+                raise JsonPatchError(f'array index {last} out of bounds')
             parent.insert(idx, value)
     else:
         raise JsonPatchError('add target parent is a scalar')
+    return doc
+
+
+def _ensure_parents(doc: Any, tokens: List[str]) -> Any:
+    """Create missing intermediate containers along an add path
+    (evanphx/json-patch EnsurePathExistsOnAdd). A next token that is an
+    array index or ``-`` makes the missing container a list, else a map."""
+    cur = doc
+    for i, t in enumerate(tokens[:-1]):
+        nxt = tokens[i + 1]
+        want_list = nxt == '-' or nxt.lstrip('-').isdigit()
+        if isinstance(cur, dict):
+            if t not in cur or cur[t] is None:
+                cur[t] = [] if want_list else {}
+            cur = cur[t]
+        elif isinstance(cur, list):
+            if t == '-':
+                cur.append([] if want_list else {})
+                cur = cur[-1]
+            else:
+                try:
+                    idx = int(t)
+                except ValueError:
+                    raise JsonPatchError(f'invalid array index {t!r}')
+                if idx < 0:
+                    idx += len(cur)
+                if idx == len(cur):
+                    cur.append([] if want_list else {})
+                if idx < 0 or idx >= len(cur):
+                    raise JsonPatchError(f'array index {t} out of bounds')
+                if cur[idx] is None:
+                    cur[idx] = [] if want_list else {}
+                cur = cur[idx]
+        else:
+            raise JsonPatchError(f'cannot create path under scalar at {t!r}')
     return doc
 
 
@@ -128,16 +175,30 @@ def _op_replace(doc: Any, tokens: List[str], value: Any) -> Any:
     return doc
 
 
-def _op_remove(doc: Any, tokens: List[str]) -> Any:
-    parent, last = _resolve_parent(doc, tokens)
+def _op_remove(doc: Any, tokens: List[str],
+               allow_missing: bool = False) -> Any:
+    try:
+        parent, last = _resolve_parent(doc, tokens)
+    except JsonPatchError:
+        if allow_missing:
+            return doc
+        raise
     if isinstance(parent, dict):
         if last not in parent:
+            if allow_missing:
+                return doc
             raise JsonPatchError(f'remove path not found: {last!r}')
         del parent[last]
     elif isinstance(parent, list):
         try:
-            del parent[int(last)]
-        except (ValueError, IndexError):
+            idx = int(last)
+        except ValueError:
+            raise JsonPatchError(f'invalid array index {last!r}')
+        if idx < 0:
+            idx += len(parent)
+        if 0 <= idx < len(parent):
+            del parent[idx]
+        elif not allow_missing:
             raise JsonPatchError(f'invalid array index {last!r}')
     else:
         raise JsonPatchError('remove target parent is a scalar')

@@ -26,18 +26,26 @@ NETWORK_BOUND = {
 }
 
 
+REFERENCE_MUTATE_CORPUS = '/root/reference/test/cli/test-mutate'
+REFERENCE_GENERATE_CORPUS = '/root/reference/test/cli/test-generate'
+REFERENCE_FAIL_CORPUS = '/root/reference/test/cli/test-fail'
+
+
 def _find_fixtures():
-    if not os.path.isdir(REFERENCE_CORPUS):
-        return []
     from kyverno_tpu.cli.test_command import find_test_files
-    return find_test_files(REFERENCE_CORPUS)
+    out = []
+    for corpus in (REFERENCE_CORPUS, REFERENCE_MUTATE_CORPUS,
+                   REFERENCE_GENERATE_CORPUS):
+        if os.path.isdir(corpus):
+            out.extend(find_test_files(corpus))
+    return out
 
 
 FIXTURES = _find_fixtures()
 
 
 def _fixture_id(path):
-    return os.path.relpath(os.path.dirname(path), REFERENCE_CORPUS)
+    return os.path.relpath(os.path.dirname(path), '/root/reference/test/cli')
 
 
 @pytest.mark.skipif(not FIXTURES, reason='reference corpus not available')
@@ -58,3 +66,19 @@ def test_reference_cli_fixture(fixture):
         raise AssertionError(
             f'{name}: {len(failed)}/{len(rows)} rows diverged:\n  ' +
             '\n  '.join(failed))
+
+
+# reference: .github/workflows/cli.yaml:45-47 — these fixtures must make
+# `kyverno test` exit non-zero (missing policy/rule/resource rows diverge)
+EXPECTED_FAIL_DIRS = ['missing-policy', 'missing-rule', 'missing-resource']
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE_FAIL_CORPUS),
+                    reason='reference corpus not available')
+@pytest.mark.parametrize('subdir', EXPECTED_FAIL_DIRS)
+def test_reference_cli_expected_failures(subdir):
+    from kyverno_tpu.cli.test_command import find_test_files, run_test_file
+    files = find_test_files(os.path.join(REFERENCE_FAIL_CORPUS, subdir))
+    assert files, f'no fixtures under {subdir}'
+    _, rows = run_test_file(files[0])
+    assert any(not row.ok for row in rows),         f'{subdir}: expected at least one diverging row'
