@@ -7,6 +7,7 @@ prints mutated output plus a pass/fail/warn/error/skip summary.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Dict, List
 
@@ -65,6 +66,25 @@ def command(args) -> int:
     if not resources:
         print('no resources found')
         return 1
+
+    # -o handling (reference: apply_command.go:298-318 checkMutateLogPath +
+    # createFileOrFolder): a path whose last segment ends in .yml/.yaml is a
+    # file — created (with parents) and truncated once per invocation, then
+    # appended to; any other path is a directory — created if missing, and
+    # each resource overwrites its own <name>-mutated.yaml inside it
+    out_path = getattr(args, 'output', None)
+    if out_path:
+        try:
+            if _mutate_path_is_dir(out_path):
+                os.makedirs(out_path, exist_ok=True)
+            else:
+                parent = os.path.dirname(out_path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                open(out_path, 'w', encoding='utf-8').close()
+        except OSError as exc:
+            print(f'failed to create file/folder at {out_path}: {exc}')
+            return 1
 
     rule_count = sum(
         len(p.spec.get('rules') or []) for p in policies)
@@ -139,6 +159,16 @@ def _count(result, rc: ResultCounts, audit_warn: bool = False) -> None:
                 rc.skip += 1
 
 
+def _mutate_path_is_dir(path: str) -> bool:
+    """Extension-based dir/file split for -o (reference:
+    apply_command.go:448 checkMutateLogPath — last dot-suffix of the last
+    path segment must be yml/yaml for file mode)."""
+    # no slash-stripping: "logs.yaml/" has last segment "" → directory,
+    # exactly as the reference's strings.Split behaves
+    last = path.split('/')[-1]
+    return last.split('.')[-1] not in ('yml', 'yaml')
+
+
 def _print_mutation(result, policy, resource, args) -> None:
     mutated = result.patched_resource
     if mutated is None or mutated == resource:
@@ -152,7 +182,17 @@ def _print_mutation(result, policy, resource, args) -> None:
     text = yaml.safe_dump(mutated, sort_keys=False)
     rname = (resource.get('metadata') or {}).get('name', '')
     if getattr(args, 'output', None):
-        with open(args.output, 'a', encoding='utf-8') as f:
+        # file mode appends within the run; dir mode overwrites one
+        # <resource>-mutated.yaml per resource (reference:
+        # utils/common/common.go:567-577 PrintMutatedOutput, filename from
+        # common.go:934)
+        path = args.output
+        if _mutate_path_is_dir(path):
+            path = os.path.join(path, f'{rname}-mutated.yaml')
+            mode = 'w'
+        else:
+            mode = 'a'
+        with open(path, mode, encoding='utf-8') as f:
             f.write(text + '\n---\n\n')
     else:
         print(f'\nmutate policy {policy.name} applied to '

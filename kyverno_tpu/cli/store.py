@@ -15,7 +15,9 @@ class Store:
         self.mock = False
         self.registry_access = False
         self.allow_api_calls = False
-        self.foreach_element = -1
+        # matches the reference's Go zero-value: store.ForeachElement is
+        # never set by the CLI, so the mock loader always injects element 0
+        self.foreach_element = 0
         # policy name -> rule name -> {key: value}
         self.rule_values: Dict[str, Dict[str, Dict[str, Any]]] = {}
         # policy name -> rule name -> {key: [values per foreach element]}
