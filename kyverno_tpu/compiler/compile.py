@@ -1,19 +1,20 @@
-"""Policy-set compiler: validate rules → vectorized check programs.
+"""Policy-set compiler v2: validate rules → tri-state status programs.
 
-Compiles the vectorizable subset (pattern / anyPattern rules over scalar
-paths and one array-of-maps level, with conditional / equality / negation /
-existence anchors and the full string-operator grammar). Rules outside the
-subset — variables, context entries, preconditions, deny, foreach,
-podSecurity, nested arrays, metadata wildcards — fall back to the host
-engine, preserving exact semantics.
-
-The leaf compilation mirrors the reference's OR-chain coercions
-(reference: pkg/engine/pattern/pattern.go:207 validateString tries
-duration, then quantity, then wildcard string).
+Compiles pattern / anyPattern / deny / preconditions rules into
+:class:`StatusExpr` trees that mirror the reference's anchor walk
+(reference: pkg/engine/validate/validate.go, pkg/engine/anchor/handlers.go)
+and condition evaluation (reference: pkg/engine/variables/operator/*.go).
+Rules outside the vocabulary — context entries, foreach, manifests,
+unresolvable variables, exotic operand shapes — fall back to the host
+engine, preserving exact semantics.  Individual undecidable *checks*
+(long strings, overflowing arrays, runtime wildcards) surface as
+STATUS_HOST per resource instead of forcing the whole rule to host.
 """
 
 from __future__ import annotations
 
+import json
+import re
 from fractions import Fraction
 from typing import Any, List, Optional, Tuple
 
@@ -21,12 +22,13 @@ from ..api.policy import Policy
 from ..autogen.autogen import compute_rules
 from ..engine import anchor as anchor_mod
 from ..engine import pattern as leaf_pattern
+from ..engine.validate_pattern import has_nested_anchors
 from ..engine.variables import is_reference, is_variable
 from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
-from .ir import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT, CMP_NE, MAX_ELEMS,
-                 STR_LEN, TAIL_LEN, BoolExpr, CompiledPolicySet, CompileError,
-                 ElementBlock, Leaf, RuleProgram, Slot)
+from .ir import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT, CMP_NE, STR_LEN,
+                 TAIL_LEN, BoolExpr, CompileError, CompiledPolicySet,
+                 CondCheck, GatherSlot, Leaf, RuleProgram, Slot, StatusExpr)
 
 _CMP_OF_OP = {
     leaf_pattern.OP_MORE: CMP_GT,
@@ -36,6 +38,9 @@ _CMP_OF_OP = {
     leaf_pattern.OP_EQUAL: CMP_EQ,
     leaf_pattern.OP_NOT_EQUAL: CMP_NE,
 }
+
+# a condition key of exactly one {{ ... }} expression
+_SINGLE_VAR_RE = re.compile(r'^\{\{(.*)\}\}$', re.DOTALL)
 
 
 def compile_policies(policies: List[Policy]) -> CompiledPolicySet:
@@ -57,38 +62,52 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     if not rule.get('validate'):
         raise CompileError('not a validate rule')
     validate = rule['validate']
-    if rule.get('context') or rule.get('preconditions'):
-        raise CompileError('context/preconditions require the host engine')
-    unsupported = [k for k in ('deny', 'foreach', 'podSecurity', 'manifests')
+    if rule.get('context'):
+        raise CompileError('context entries require the host engine')
+    unsupported = [k for k in ('foreach', 'manifests')
                    if validate.get(k) is not None]
     if unsupported:
         raise CompileError(f'unsupported validate type {unsupported}')
-    match = rule.get('match') or {}
-    _require_simple_match(match)
-    _require_simple_match(rule.get('exclude') or {})
+    if not isinstance(rule.get('match', {}) or {}, dict) or \
+            not isinstance(rule.get('exclude', {}) or {}, dict):
+        raise CompileError('bad match/exclude block')
 
     name = rule.get('name', '')
-    if validate.get('pattern') is not None:
-        scalar, scalar_cond, blocks = _compile_pattern(
-            cps, validate['pattern'])
-        return RuleProgram(
-            policy_name=policy.name, rule_name=name,
-            policy_index=p_idx, rule_index=r_idx,
-            scalar=scalar, scalar_condition=scalar_cond,
-            elements=tuple(blocks),
-            pass_message=f"validation rule '{name}' passed.",
-            background=policy.background, rule_raw=rule)
-    if validate.get('anyPattern') is not None:
-        raise CompileError('anyPattern compiled per-sub-pattern in v2')
-    raise CompileError('no pattern')
+    units: List[StatusExpr] = []
+
+    # preconditions gate everything (engine.py Validator.validate order)
+    if rule.get('preconditions') is not None:
+        pre = _compile_conditions(cps, rule['preconditions'])
+        units.append(StatusExpr('precond', expr=pre))
+
+    if validate.get('deny') is not None:
+        deny = _compile_conditions(cps, (validate['deny'] or {}).get('conditions'))
+        units.append(StatusExpr('deny', expr=deny))
+    elif validate.get('pattern') is not None:
+        units.append(_compile_pattern_status(cps, validate['pattern']))
+    elif validate.get('anyPattern') is not None:
+        pats = validate['anyPattern']
+        if not isinstance(pats, list):
+            raise CompileError('anyPattern must be a list')
+        children = [_compile_pattern_status(cps, p, in_any_pattern=True)
+                    for p in pats]
+        units.append(StatusExpr('any', children=tuple(children)))
+    elif validate.get('podSecurity') is not None:
+        from .pss_compile import compile_pod_security
+        units.append(compile_pod_security(cps, validate['podSecurity']))
+    else:
+        raise CompileError('no compilable validate sub-key')
+
+    return RuleProgram(
+        policy_name=policy.name, rule_name=name,
+        policy_index=p_idx, rule_index=r_idx,
+        status=StatusExpr.seq(units),
+        pass_message=f"validation rule '{name}' passed.",
+        background=policy.background, rule_raw=rule)
 
 
-def _require_simple_match(match: dict) -> None:
-    """The device path precomputes match host-side; that host precompute
-    supports everything, so only sanity-check shape here."""
-    if not isinstance(match, dict):
-        raise CompileError('bad match block')
-
+# ---------------------------------------------------------------------------
+# Pattern compilation (tree-walk → StatusExpr)
 
 def _check_no_vars(value: Any) -> None:
     if isinstance(value, str) and (is_variable(value) or is_reference(value)):
@@ -102,178 +121,195 @@ def _check_no_vars(value: Any) -> None:
             _check_no_vars(v)
 
 
-def _compile_pattern(cps: CompiledPolicySet, pattern: Any):
-    """Compile a pattern tree rooted at the resource."""
+def _compile_pattern_status(cps: CompiledPolicySet, pattern: Any,
+                            in_any_pattern: bool = False) -> StatusExpr:
+    """Compile one pattern tree rooted at the resource document."""
     _check_no_vars(pattern)
     if not isinstance(pattern, dict):
         raise CompileError('top-level pattern must be a map')
-    scalar_parts: List[BoolExpr] = []
-    cond_parts: List[BoolExpr] = []
-    blocks: List[ElementBlock] = []
-    _walk_map(cps, pattern, (), scalar_parts, cond_parts, blocks)
-    scalar = BoolExpr.all(scalar_parts) if scalar_parts else None
-    cond = BoolExpr.all(cond_parts) if cond_parts else None
-    return scalar, cond, blocks
+    tracked: List[Slot] = []
+    status = _compile_map(cps, pattern, (), tracked)
+    if in_any_pattern or not tracked:
+        # anyPattern sub-failures stay failures regardless of missing anchor
+        # keys (engine.py:524 treats empty-path errors as plain failures)
+        return status
+    # single-pattern quirk (validate_pattern.match_pattern:38 +
+    # engine.py:493): a plain FAIL while any tracked condition/existence/
+    # negation anchor key was missing (null counts as missing) surfaces as
+    # ERROR with empty path → undecidable on device, send to host
+    guards = [BoolExpr.of(Leaf(s, 'star')) for s in tracked]
+    return StatusExpr('trackfail', expr=BoolExpr.all(guards), sub=status)
 
 
-def _walk_map(cps: CompiledPolicySet, pattern: dict, path: Tuple[str, ...],
-              scalar_parts: List[BoolExpr], cond_parts: List[BoolExpr],
-              blocks: List[ElementBlock]) -> None:
+def _phase1_sort_key(key: str) -> str:
+    return key
+
+
+def _compile_map(cps: CompiledPolicySet, pattern: dict,
+                 path: Tuple[str, ...], tracked: List[Slot]) -> StatusExpr:
+    """Compile a pattern map at ``path`` (``'*'`` marks element scope).
+
+    Mirrors _validate_map: phase 1 anchors in sorted key order, then plain
+    keys with nested-anchor/global keys first (validate_pattern.py:77-92).
+    The caller has already guarded that the resource node is a map.
+    """
+    anchors, plains = {}, {}
     for key, value in pattern.items():
         a = anchor_mod.parse(key)
-        bare = a.key if a else key
-        child_path = path + (bare,)
-        if a is not None and anchor_mod.is_global(a):
-            raise CompileError('global anchors not vectorized')
-        if a is not None and anchor_mod.is_condition(a):
-            # map-level conditional anchor: mismatch or missing → rule skip
-            if isinstance(value, (dict, list)):
-                raise CompileError('nested conditional anchors not vectorized')
-            cond_parts.append(_compile_leaf(cps, child_path, value,
-                                            missing_ok=False))
-            continue
-        if a is not None and anchor_mod.is_negation(a):
-            slot = Slot(child_path)
-            cps.slot_id(slot)
-            scalar_parts.append(BoolExpr.of(Leaf(slot, 'absent')))
-            continue
-        if a is not None and anchor_mod.is_existence(a):
+        if anchor_mod.is_condition(a) or anchor_mod.is_existence(a) or \
+                anchor_mod.is_equality(a) or anchor_mod.is_negation(a):
+            anchors[key] = (a, value)
+        else:
+            plains[key] = (a, value)
+
+    children: List[StatusExpr] = []
+
+    for key in sorted(anchors, key=_phase1_sort_key):
+        a, value = anchors[key]
+        if _key_has_wildcard(a.key):
+            raise CompileError(f'wildcard pattern key not vectorized: {key}')
+        child_path = path + (a.key,)
+        slot = Slot(child_path)
+        _require_depth(slot)
+        cps.slot_id(slot)
+        if anchor_mod.is_condition(a):
+            tracked.append(slot)
+            sub = _compile_element(cps, value, child_path, tracked)
+            children.append(StatusExpr('cond', slot=slot, sub=sub))
+        elif anchor_mod.is_equality(a):
+            sub = _compile_element(cps, value, child_path, tracked)
+            children.append(StatusExpr('equality', slot=slot, sub=sub))
+        elif anchor_mod.is_negation(a):
+            tracked.append(slot)
+            children.append(StatusExpr('negation', slot=slot))
+        elif anchor_mod.is_existence(a):
+            tracked.append(slot)
             if not isinstance(value, list) or not value or \
                     not all(isinstance(e, dict) for e in value):
                 raise CompileError('existence anchor pattern must be a '
                                    'list of maps')
             for elem_pattern in value:
-                blocks.append(_compile_element_block(
-                    cps, child_path, elem_pattern, mode='exists'))
-            continue
-        missing_ok = a is not None and anchor_mod.is_equality(a)
-        if isinstance(value, dict):
-            if missing_ok:
-                raise CompileError('=() on maps not vectorized')
-            if _has_wildcard_key(value):
-                raise CompileError('wildcard keys not vectorized')
-            _walk_map(cps, value, child_path, scalar_parts, cond_parts,
-                      blocks)
-        elif isinstance(value, list):
-            if not value:
-                raise CompileError('empty pattern array')
-            first = value[0]
-            if isinstance(first, dict):
-                if len(value) != 1:
-                    raise CompileError('multi-element array patterns not '
-                                       'vectorized')
-                blocks.append(_compile_element_block(cps, child_path, first,
-                                                     mode='forall',
-                                                     missing_ok=missing_ok))
-            elif isinstance(first, (str, int, float, bool)) or first is None:
-                # every array element must match the scalar pattern
-                slot_path = child_path + ('*',)
-                constraint = _compile_leaf(cps, slot_path, first,
-                                           missing_ok=False)
-                blocks.append(ElementBlock(
-                    array_path=child_path, condition=None,
-                    constraint=constraint))
-            else:
-                raise CompileError('unsupported array pattern')
-        else:
-            scalar_parts.append(_compile_leaf(cps, child_path, value,
-                                              missing_ok=missing_ok))
+                elem_sub = _compile_elem_map(cps, elem_pattern,
+                                             child_path + ('*',), tracked)
+                children.append(StatusExpr('exists', slot=slot, sub=elem_sub))
 
-
-def _has_wildcard_key(pattern: dict) -> bool:
-    return any(('*' in k or '?' in k) for k in pattern)
-
-
-def _compile_element_block(cps: CompiledPolicySet, array_path: Tuple[str, ...],
-                           elem_pattern: dict, mode: str,
-                           missing_ok: bool = False) -> ElementBlock:
-    if missing_ok:
-        raise CompileError('=() array anchors not vectorized')
-    cond_parts: List[BoolExpr] = []
-    cons_parts: List[BoolExpr] = []
-    for key, value in elem_pattern.items():
-        a = anchor_mod.parse(key)
+    for key in _plain_order(plains):
+        a, value = plains[key]
         bare = a.key if a else key
-        slot_path = array_path + ('*', bare)
-        if a is not None and anchor_mod.is_condition(a):
-            if isinstance(value, (dict, list)):
-                raise CompileError('nested element conditions not vectorized')
-            cond_parts.append(_compile_leaf(cps, slot_path, value,
-                                            missing_ok=False))
-            continue
-        if a is not None and anchor_mod.is_negation(a):
-            slot = Slot(slot_path)
+        if _key_has_wildcard(bare):
+            raise CompileError(f'wildcard pattern key not vectorized: {key}')
+        child_path = path + (bare,)
+        if a is not None and anchor_mod.is_global(a):
+            slot = Slot(child_path)
+            _require_depth(slot)
             cps.slot_id(slot)
-            cons_parts.append(BoolExpr.of(Leaf(slot, 'absent')))
+            sub = _compile_element(cps, value, child_path, tracked)
+            children.append(StatusExpr('global', slot=slot, sub=sub))
             continue
-        if a is not None and not anchor_mod.is_equality(a):
-            raise CompileError(f'anchor {key} not vectorized in elements')
-        missing_ok_leaf = a is not None and anchor_mod.is_equality(a)
-        if isinstance(value, dict):
-            # nested map inside element: flatten one extra level of scalars
-            _flatten_nested(cps, slot_path, value, cons_parts,
-                            missing_ok_leaf)
-        elif isinstance(value, list):
-            raise CompileError('nested arrays not vectorized')
-        else:
-            cons_parts.append(_compile_leaf(cps, slot_path, value,
-                                            missing_ok=missing_ok_leaf))
-    if not cons_parts and not cond_parts:
-        raise CompileError('empty element pattern')
-    condition = BoolExpr.all(cond_parts) if cond_parts else None
-    if cons_parts:
-        constraint = BoolExpr.all(cons_parts)
-    else:
-        true_slot = Slot(array_path + ('*',))
-        cps.slot_id(true_slot)
-        constraint = BoolExpr.of(Leaf(true_slot, 'true'))
-    if mode == 'exists':
-        return ElementBlock(array_path=array_path, condition=None,
-                            constraint=BoolExpr.all(cond_parts + cons_parts),
-                            mode='exists')
-    return ElementBlock(array_path=array_path, condition=condition,
-                        constraint=constraint)
+        if a is not None and anchor_mod.is_add_if_not_present(a):
+            continue  # mutation-only anchor: no-op during validation
+        # default key (anchor.py handle_element default branch): the
+        # "*" pattern passes on any non-null value, fails when missing
+        if value == '*':
+            slot = Slot(child_path)
+            _require_depth(slot)
+            cps.slot_id(slot)
+            children.append(StatusExpr(
+                'leaf', expr=BoolExpr.of(Leaf(slot, 'star'))))
+            continue
+        children.append(_compile_element(cps, value, child_path, tracked))
+
+    return StatusExpr.seq(children)
 
 
-def _flatten_nested(cps: CompiledPolicySet, base_path: Tuple[str, ...],
-                    pattern: dict, out: List[BoolExpr],
-                    missing_ok: bool) -> None:
-    """Flatten nested scalar maps under an element, e.g.
-    containers[].securityContext.privileged."""
-    for key, value in pattern.items():
-        a = anchor_mod.parse(key)
-        bare = a.key if a else key
-        if a is not None and anchor_mod.is_negation(a):
-            slot = Slot(base_path + (bare,))
-            cps.slot_id(slot)
-            out.append(BoolExpr.of(Leaf(slot, 'absent')))
-            continue
-        if a is not None and not anchor_mod.is_equality(a):
-            raise CompileError('nested anchors not vectorized')
-        leaf_missing_ok = missing_ok or (
-            a is not None and anchor_mod.is_equality(a))
-        if isinstance(value, dict):
-            _flatten_nested(cps, base_path + (bare,), value, out,
-                            leaf_missing_ok)
-        elif isinstance(value, list):
-            raise CompileError('nested arrays not vectorized')
+def _plain_order(plains: dict) -> List[str]:
+    """validate_pattern._sorted_nested_anchor_keys ordering."""
+    front, back = [], []
+    for k in sorted(plains):
+        a, v = plains[k]
+        if anchor_mod.is_global(a) or has_nested_anchors(v):
+            front.insert(0, k)
         else:
-            out.append(_compile_leaf(cps, base_path + (bare,), value,
-                                     missing_ok=leaf_missing_ok))
+            back.append(k)
+    return front + back
+
+
+def _require_depth(slot: Slot) -> None:
+    if slot.depth > 2:
+        raise CompileError('more than two element dimensions not vectorized')
+
+
+def _compile_element(cps: CompiledPolicySet, pattern: Any,
+                     path: Tuple[str, ...],
+                     tracked: List[Slot]) -> StatusExpr:
+    """Compile _validate_element dispatch for the value at ``path``.
+
+    Mirrors validate_pattern._validate_element: maps need a map resource,
+    lists need a list resource, scalars compare leaf-wise (arrays of
+    scalars must all match — handled in eval via the array-addendum).
+    """
+    slot = Slot(path)
+    _require_depth(slot)
+    cps.slot_id(slot)
+    if isinstance(pattern, dict):
+        is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')))
+        sub = _compile_map(cps, pattern, path, tracked)
+        return StatusExpr.seq([is_map, sub])
+    if isinstance(pattern, list):
+        if not pattern:
+            raise CompileError('empty pattern array')
+        first = pattern[0]
+        is_arr = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_array')))
+        if isinstance(first, dict):
+            # validateArrayOfMaps uses only the first pattern element
+            # (reference: pkg/engine/validate/validate.go:168-173)
+            elem_sub = _compile_elem_map(cps, first, path + ('*',), tracked)
+            forall = StatusExpr('forall', slot=slot, sub=elem_sub)
+            return StatusExpr.seq([is_arr, forall])
+        if isinstance(first, (str, int, float, bool)) or first is None:
+            # scalar array pattern: every element must match the scalar
+            # (validate.go:177 routes the whole array into the scalar leaf)
+            check = _compile_leaf(cps, path, first)
+            return StatusExpr.seq([is_arr, StatusExpr('leaf', expr=check)])
+        raise CompileError('typed array patterns not vectorized')
+    if isinstance(pattern, (str, int, float, bool)) or pattern is None:
+        return StatusExpr('leaf', expr=_compile_leaf(cps, path, pattern))
+    raise CompileError(f'unsupported pattern type {type(pattern).__name__}')
+
+
+def _compile_elem_map(cps: CompiledPolicySet, elem_pattern: dict,
+                      elem_path: Tuple[str, ...],
+                      tracked: List[Slot]) -> StatusExpr:
+    """Compile the per-element pattern of an array-of-maps walk.
+
+    validateArrayOfMaps calls validateResourceElement per element, so a
+    non-map element is a plain FAIL (is_map guard at element scope).
+    """
+    if not isinstance(elem_pattern, dict):
+        raise CompileError('element pattern must be a map')
+    slot = Slot(elem_path)
+    _require_depth(slot)
+    cps.slot_id(slot)
+    is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')))
+    sub = _compile_map(cps, elem_pattern, elem_path, tracked)
+    return StatusExpr.seq([is_map, sub])
+
+
+def _key_has_wildcard(key: str) -> bool:
+    return '*' in key or '?' in key
 
 
 # ---------------------------------------------------------------------------
 # Leaf compilation
 
-def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...], pattern: Any,
-                  missing_ok: bool) -> BoolExpr:
+def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...],
+                  pattern: Any) -> BoolExpr:
     slot = Slot(path)
-    if slot.elem and path.count('*') > 1:
-        raise CompileError('nested element dimensions not vectorized')
+    _require_depth(slot)
     cps.slot_id(slot)
 
     def L(op, operand=None):
-        return BoolExpr.of(Leaf(slot, op, operand, missing_ok))
+        return BoolExpr.of(Leaf(slot, op, operand))
 
     if isinstance(pattern, bool):
         return L('eq_bool', pattern)
@@ -286,47 +322,44 @@ def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...], pattern: Any,
         if milli.denominator != 1:
             raise CompileError('sub-milli float pattern not exact on device')
         return L('eq_float', pattern)
-    if isinstance(pattern, dict):
-        raise CompileError('map leaf')
     if isinstance(pattern, str):
-        return _compile_string_pattern(slot, pattern, missing_ok)
+        return _compile_string_pattern(slot, pattern)
     raise CompileError(f'unsupported leaf type {type(pattern).__name__}')
 
 
-def _compile_string_pattern(slot: Slot, pattern: str,
-                            missing_ok: bool) -> BoolExpr:
+def _compile_string_pattern(slot: Slot, pattern: str) -> BoolExpr:
     """Compile the string operator grammar
     (reference: pkg/engine/pattern/pattern.go:152 validateStringPatterns)."""
     if pattern == '*':
-        return BoolExpr.of(Leaf(slot, 'star', None, missing_ok))
+        return BoolExpr.of(Leaf(slot, 'star'))
     ors = []
-    # exact equality short-circuit (value == pattern) is subsumed by terms
     for condition in pattern.split('|'):
         ands = []
         for term in condition.strip(' ').split('&'):
-            ands.append(_compile_string_term(slot, term.strip(' '),
-                                             missing_ok))
+            ands.append(_compile_string_term(slot, term.strip(' ')))
         ors.append(BoolExpr.all(ands))
     return BoolExpr.any(ors)
 
 
-def _compile_string_term(slot: Slot, term: str, missing_ok: bool) -> BoolExpr:
+def _compile_string_term(slot: Slot, term: str) -> BoolExpr:
     op = leaf_pattern.get_operator_from_string_pattern(term)
     if op == leaf_pattern.OP_IN_RANGE:
         m = leaf_pattern.IN_RANGE_RE.match(term)
         return BoolExpr.all([
-            _compile_string_term(slot, f'>= {m.group(1)}', missing_ok),
-            _compile_string_term(slot, f'<= {m.group(2)}', missing_ok)])
+            _compile_string_term(slot, f'>= {m.group(1)}'),
+            _compile_string_term(slot, f'<= {m.group(2)}')])
     if op == leaf_pattern.OP_NOT_IN_RANGE:
         m = leaf_pattern.NOT_IN_RANGE_RE.match(term)
         return BoolExpr.any([
-            _compile_string_term(slot, f'< {m.group(1)}', missing_ok),
-            _compile_string_term(slot, f'> {m.group(2)}', missing_ok)])
-    operand = term[len(op):].strip(' ')
-    cmp = _CMP_OF_OP[op]
+            _compile_string_term(slot, f'< {m.group(1)}'),
+            _compile_string_term(slot, f'> {m.group(2)}')])
+    operand = term[len(op):].strip(' ') if op else term
+    cmp = _CMP_OF_OP[op] if op else CMP_EQ
+    if not op:
+        operand = term
 
     def L(lop, loperand=None):
-        return BoolExpr.of(Leaf(slot, lop, loperand, missing_ok))
+        return BoolExpr.of(Leaf(slot, lop, loperand))
 
     alternatives: List[BoolExpr] = []
     # 1. duration comparison (only if operand parses as Go duration)
@@ -339,32 +372,29 @@ def _compile_string_term(slot: Slot, term: str, missing_ok: bool) -> BoolExpr:
     try:
         q = Quantity.parse(operand)
         milli = q.value * 1000
-        if milli.denominator != 1:
-            raise CompileError('sub-milli quantity operand')
-        alternatives.append(L('cmp_qty', (cmp, int(milli))))
+        if milli.denominator == 1:
+            alternatives.append(L('cmp_qty', (cmp, int(milli))))
+        # sub-milli operands skip the quantity alternative; strings that
+        # parse as quantities still hit the wildcard/string alternative
     except ValueError:
         pass
     # 3. wildcard string comparison (only for == / !=)
     if cmp in (CMP_EQ, CMP_NE):
-        str_check = _compile_wildcard_eq(slot, operand, missing_ok)
+        str_check = _compile_wildcard_eq(slot, operand)
         if cmp == CMP_NE:
-            str_check = BoolExpr.negate(str_check)
-            # NotEqual with missing key still fails the walk: negation of a
-            # missing-fails leaf would wrongly pass — force explicit handling
             str_check = BoolExpr.all([
-                BoolExpr.of(Leaf(slot, 'convertible', None, missing_ok)),
-                str_check])
+                BoolExpr.of(Leaf(slot, 'convertible')),
+                BoolExpr.negate(str_check)])
         alternatives.append(str_check)
     if not alternatives:
         raise CompileError(f'no vectorizable interpretation for {term!r}')
     return BoolExpr.any(alternatives)
 
 
-def _compile_wildcard_eq(slot: Slot, operand: str,
-                         missing_ok: bool) -> BoolExpr:
+def _compile_wildcard_eq(slot: Slot, operand: str) -> BoolExpr:
     """Classify a wildcard pattern into a vectorizable string class."""
     def L(op, loperand=None):
-        return BoolExpr.of(Leaf(slot, op, loperand, missing_ok))
+        return BoolExpr.of(Leaf(slot, op, loperand))
 
     if len(operand.encode()) > STR_LEN:
         raise CompileError('operand longer than encoded string window')
@@ -376,20 +406,179 @@ def _compile_wildcard_eq(slot: Slot, operand: str,
         return L('any_str')
     if operand == '?*':
         return L('nonempty')
-    if has_q:
-        raise CompileError(f'general ? wildcard not vectorized: {operand!r}')
-    parts = operand.split('*')
-    if len(parts) == 2 and parts[0] and not parts[1]:
-        return L('prefix', parts[0])
-    if len(parts) == 2 and not parts[0] and parts[1]:
-        if len(parts[1].encode()) > TAIL_LEN:
-            raise CompileError('suffix longer than tail window')
-        return L('suffix', parts[1])
-    if len(parts) == 3 and parts[0] and parts[2] and not parts[1]:
-        # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
-        if len(parts[2].encode()) > TAIL_LEN:
-            raise CompileError('suffix longer than tail window')
-        return BoolExpr.all([
-            L('prefix', parts[0]), L('suffix', parts[2]),
-            L('min_len', len(parts[0].encode()) + len(parts[2].encode()))])
-    raise CompileError(f'wildcard class not vectorized: {operand!r}')
+    if not has_q:
+        parts = operand.split('*')
+        if len(parts) == 2 and parts[0] and not parts[1]:
+            return L('prefix', parts[0])
+        if len(parts) == 2 and not parts[0] and parts[1]:
+            if len(parts[1].encode()) <= TAIL_LEN:
+                return L('suffix', parts[1])
+        if len(parts) == 3 and parts[0] and parts[2] and not parts[1] and \
+                len(parts[2].encode()) <= TAIL_LEN:
+            # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
+            return BoolExpr.all([
+                L('prefix', parts[0]), L('suffix', parts[2]),
+                L('min_len',
+                  len(parts[0].encode()) + len(parts[2].encode()))])
+    # general wildcard: DP over the byte window (exact when the value fits
+    # the window or the pattern is tail-decidable; else → unknown → host)
+    return L('wildcard', operand)
+
+
+# ---------------------------------------------------------------------------
+# Condition compilation (deny / preconditions)
+
+_SUPPORTED_COND_OPS = {
+    'equal', 'equals', 'notequal', 'notequals',
+    'in', 'anyin', 'allin', 'notin', 'anynotin', 'allnotin',
+    'greaterthanorequals', 'greaterthan', 'lessthanorequals', 'lessthan',
+}
+
+
+def _compile_conditions(cps: CompiledPolicySet, conditions: Any) -> BoolExpr:
+    """Compile any/all condition blocks to a BoolExpr
+    (semantics: kyverno_tpu/engine/operators.py evaluate_conditions)."""
+    if conditions is None:
+        return BoolExpr.of(Leaf(Slot(()), 'true'))
+    if isinstance(conditions, dict):
+        return _compile_any_all(cps, conditions)
+    if isinstance(conditions, list):
+        if conditions and all(isinstance(c, dict) and
+                              ('any' in c or 'all' in c)
+                              for c in conditions):
+            return BoolExpr.all([_compile_any_all(cps, c)
+                                 for c in conditions])
+        if not conditions:
+            raise CompileError('empty legacy condition list')
+        return BoolExpr.all([_compile_condition(cps, c)
+                             for c in conditions])
+    raise CompileError('bad conditions shape')
+
+
+def _compile_any_all(cps: CompiledPolicySet, block: dict) -> BoolExpr:
+    parts: List[BoolExpr] = []
+    any_conditions = block.get('any')
+    all_conditions = block.get('all')
+    if any_conditions is not None:
+        if not isinstance(any_conditions, list):
+            raise CompileError('bad any block')
+        if not any_conditions:
+            # any([]) is False in the host evaluator
+            parts.append(BoolExpr.negate(
+                BoolExpr.of(Leaf(Slot(()), 'true'))))
+        else:
+            parts.append(BoolExpr.any(
+                [_compile_condition(cps, c) for c in any_conditions]))
+    if all_conditions:
+        if not isinstance(all_conditions, list):
+            raise CompileError('bad all block')
+        parts.append(BoolExpr.all(
+            [_compile_condition(cps, c) for c in all_conditions]))
+    if not parts:
+        return BoolExpr.of(Leaf(Slot(()), 'true'))
+    return BoolExpr.all(parts)
+
+
+def _compile_condition(cps: CompiledPolicySet, cond: Any) -> BoolExpr:
+    if not isinstance(cond, dict):
+        raise CompileError('bad condition')
+    op = str(cond.get('operator', '')).lower()
+    if op not in _SUPPORTED_COND_OPS:
+        raise CompileError(f'operator {op!r} not vectorized')
+    key = cond.get('key')
+    value = cond.get('value')
+    _check_constant(value)
+    gather, _ = _compile_condition_key(key)
+    cps.gather_id(gather)
+    return BoolExpr.of_cond(CondCheck(
+        gather=gather, op=op, values=_normalize_values(value),
+        list_value=isinstance(value, list)))
+
+
+def _check_constant(value: Any) -> None:
+    """Condition values must be variable-free constants."""
+    if isinstance(value, str) and (is_variable(value) or is_reference(value)):
+        raise CompileError(f'variable in condition value: {value!r}')
+    if isinstance(value, list):
+        for v in value:
+            _check_constant(v)
+    if isinstance(value, dict):
+        raise CompileError('map-typed condition value not vectorized')
+
+
+def _normalize_values(value: Any) -> Tuple[Any, ...]:
+    if isinstance(value, list):
+        return tuple(value)
+    return (value,)
+
+
+def _compile_condition_key(key: Any) -> Tuple[GatherSlot, bool]:
+    """Compile a condition key — a single ``{{ jmespath }}`` over
+    ``request.object`` — into a gather program.
+
+    Returns (gather, scalar_key): scalar_key is True when the expression
+    cannot produce a list (no projections/multiselect), matching the host
+    operators' type dispatch on the queried value.
+    """
+    if not isinstance(key, str):
+        raise CompileError('non-string condition key not vectorized')
+    m = _SINGLE_VAR_RE.match(key.strip())
+    if not m:
+        raise CompileError(f'condition key is not a single variable: {key!r}')
+    expr = m.group(1).strip()
+    if '{{' in expr:
+        raise CompileError('nested variables not vectorized')
+    from ..engine.jmespath.parser import parse as jp_parse
+    try:
+        ast = jp_parse(expr)
+    except Exception as e:  # noqa: BLE001 - parser errors → host
+        raise CompileError(f'unparseable condition key: {e}')
+    first = []
+    scalar = _validate_gather_ast(ast, first)
+    if first[:2] != ['request', 'object']:
+        raise CompileError('condition key must address request.object')
+    return GatherSlot(expr), scalar
+
+
+def _validate_gather_ast(node: dict, fields: List[str]) -> bool:
+    """Check that a JMESPath AST is a shape the gather encoder supports;
+    collect leading field names into ``fields``. Returns True when the
+    expression is scalar-shaped (no projections), which drives the host
+    operators' type dispatch. Exotic shapes raise CompileError → host."""
+    t = node.get('type')
+    if t == 'subexpression':
+        scalar = True
+        for child in node['children']:
+            scalar = _validate_gather_ast(child, fields) and scalar
+        return scalar
+    if t == 'field':
+        fields.append(node['value'])
+        return True
+    if t == 'projection':
+        lhs, rhs = node['children']
+        _validate_gather_ast(lhs, fields)
+        if rhs.get('type') != 'identity':
+            _validate_gather_ast(rhs, [])
+        return False
+    if t == 'flatten':
+        _validate_gather_ast(node['children'][0], fields)
+        return False
+    if t == 'multi_select_list':
+        for child in node['children']:
+            if child.get('type') not in ('field', 'subexpression'):
+                raise CompileError('complex multiselect not vectorized')
+            _validate_gather_ast(child, [])
+        return False
+    if t == 'function_expression' and node.get('value') == 'keys' and \
+            len(node['children']) == 1 and \
+            node['children'][0].get('type') == 'current':
+        return False
+    if t == 'or_expression':
+        lhs, rhs = node['children']
+        if rhs.get('type') != 'literal' or isinstance(
+                rhs.get('value'), (dict, list)):
+            raise CompileError('non-literal || fallback not vectorized')
+        return _validate_gather_ast(lhs, fields)
+    if t == 'identity':
+        return True
+    raise CompileError(f'JMESPath shape {t!r} not vectorized')

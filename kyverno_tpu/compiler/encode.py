@@ -1,43 +1,54 @@
-"""Batch encoder: resources → fixed-shape slot tensors.
+"""Batch encoder v2: resources → fixed-shape slot + gather tensors.
 
-Projects each resource onto the compiled slot table (the document never
-reaches the device). Encoding is conservative toward FAIL: any value the
-encoder cannot represent exactly gets invalid flags, which can only turn a
-device PASS into a device non-pass — and all non-pass verdicts are
-re-materialized by the host engine, so correctness is preserved.
+Projects each resource onto the compiled slot table and evaluates gather
+expressions with the in-repo JMESPath interpreter (the document itself
+never reaches the device).  Encoding is conservative toward UNKNOWN: any
+value the encoder cannot represent exactly sets flags that make the
+device evaluator emit STATUS_HOST, after which the host engine re-runs
+that (resource, rule) pair — correctness is never lost.
 
-Channels per slot (scalar slots shape [R], element slots [R, E]):
+Lane schema (shared by slots and gather elements; shapes are [R],
+[R, E], [R, E, E2] for slots by star-depth, [R, G] for gathers):
   tag        i8   type tag (ir.TAG_*)
-  milli      i64  numeric value ×1000 (ints exact; quantities; null → 0)
-  milli_ok   bool
-  nanos      i64  Go duration in ns (strings with units; null → 0)
+  milli      i64  numeric value ×1000 (ints exact; quantity strings)
+  milli_ok   bool milli lane is exact
+  nanos      i64  Go duration in ns (strings with units)
   nanos_ok   bool
-  str_is_int / str_is_float  bool  (string parse classes)
-  str_len    i32  byte length of the value's Go string form
+  str_is_int / str_is_float / str_is_qty / str_is_dur   bool
+  has_wild   bool value's string form contains * or ? (gathers only)
+  str_len    i32  byte length of the value's string form
   str_head   u8[STR_LEN]  first bytes
   str_tail   u8[TAIL_LEN] last bytes, right-aligned
-Arrays referenced by element blocks additionally get:
-  arr_tag    i8   tag of the array node itself
-  elem_count i32
+Array nodes referenced by forall/exists additionally get, keyed by path:
+  count      i32  number of elements (clamped to MAX_ELEMS)
+  overflow   bool more than MAX_ELEMS elements → device UNKNOWN
+Gathers additionally get:
+  kind       i8   0 = null/absent, 1 = scalar, 2 = list
+  count      i32
+  overflow   bool
 """
 
 from __future__ import annotations
 
 import math
 from fractions import Fraction
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
-from .ir import (MAX_ELEMS, STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT, TAG_INT,
-                 TAG_MAP, TAG_MISSING, TAG_NULL, TAG_STRING,
-                 CompiledPolicySet, Slot)
-
-TAIL_LEN = 16
+from .ir import (MAX_ELEMS, MAX_GATHER, STR_LEN, TAG_ARRAY, TAG_BOOL,
+                 TAG_FLOAT, TAG_INT, TAG_MAP, TAG_MISSING, TAG_NULL,
+                 TAG_STRING, TAIL_LEN, CompiledPolicySet, GatherSlot, Slot,
+                 StatusExpr)
 
 _INT64_MAX = (1 << 63) - 1
+
+_MISSING = object()
+
+# lane bundles a slot/gather may need (computed from the ops that read it)
+NEED_STR, NEED_MILLI, NEED_NANOS, NEED_WILD = 'str', 'milli', 'nanos', 'wild'
 
 
 def _go_float_str(v: float) -> str:
@@ -45,51 +56,222 @@ def _go_float_str(v: float) -> str:
     return _go_format_float_e(v)
 
 
-class SlotArrays:
-    """numpy arrays for one slot."""
+def _sprint(v: Any) -> str:
+    """Go fmt.Sprint for scalars (operators.py:_sprint)."""
+    if isinstance(v, bool):
+        return 'true' if v else 'false'
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e21:
+            return str(int(v))
+        return repr(v)
+    return str(v)
 
-    def __init__(self, n: int, elem: bool):
-        shape = (n, MAX_ELEMS) if elem else (n,)
+
+class Lanes:
+    """numpy lane arrays for one slot or gather at a given shape."""
+
+    def __init__(self, shape: Tuple[int, ...], needs: frozenset):
+        self.needs = needs
         self.tag = np.zeros(shape, np.int8)
-        self.milli = np.zeros(shape, np.int64)
-        self.milli_ok = np.zeros(shape, bool)
-        self.nanos = np.zeros(shape, np.int64)
-        self.nanos_ok = np.zeros(shape, bool)
-        self.str_is_int = np.zeros(shape, bool)
-        self.str_is_float = np.zeros(shape, bool)
-        self.str_len = np.zeros(shape, np.int32)
-        self.str_head = np.zeros(shape + (STR_LEN,), np.uint8)
-        self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8)
+        z64 = lambda: np.zeros(shape, np.int64)  # noqa: E731
+        zb = lambda: np.zeros(shape, bool)       # noqa: E731
+        self.milli = z64() if NEED_MILLI in needs else None
+        self.milli_ok = zb() if NEED_MILLI in needs else None
+        self.nanos = z64() if NEED_NANOS in needs else None
+        self.nanos_ok = zb() if NEED_NANOS in needs else None
+        if NEED_STR in needs:
+            self.str_is_int = zb()
+            self.str_is_float = zb()
+            self.str_is_qty = zb()
+            self.str_is_dur = zb()
+            self.str_len = np.zeros(shape, np.int32)
+            self.str_head = np.zeros(shape + (STR_LEN,), np.uint8)
+            self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8)
+        else:
+            self.str_is_int = self.str_is_float = None
+            self.str_is_qty = self.str_is_dur = None
+            self.str_len = self.str_head = self.str_tail = None
+        self.has_wild = zb() if NEED_WILD in needs else None
 
-    def tensors(self) -> Dict[str, np.ndarray]:
-        return {k: getattr(self, k) for k in (
-            'tag', 'milli', 'milli_ok', 'nanos', 'nanos_ok', 'str_is_int',
-            'str_is_float', 'str_len', 'str_head', 'str_tail')}
+    _LANE_NAMES = ('tag', 'milli', 'milli_ok', 'nanos', 'nanos_ok',
+                   'str_is_int', 'str_is_float', 'str_is_qty', 'str_is_dur',
+                   'str_len', 'str_head', 'str_tail', 'has_wild')
 
-
-class Batch:
-    def __init__(self, n: int):
-        self.n = n
-        self.slots: Dict[Slot, SlotArrays] = {}
-        self.arrays: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
-
-    def tensors(self) -> Dict[str, np.ndarray]:
+    def tensors(self, prefix: str) -> Dict[str, np.ndarray]:
         out = {}
-        for i, (slot, arrs) in enumerate(self.slots.items()):
-            for k, v in arrs.tensors().items():
-                out[f's{i}_{k}'] = v
-        for j, (path, d) in enumerate(self.arrays.items()):
-            out[f'a{j}_tag'] = d['arr_tag']
-            out[f'a{j}_count'] = d['elem_count']
+        for name in self._LANE_NAMES:
+            v = getattr(self, name)
+            if v is not None:
+                out[f'{prefix}_{name}'] = v
         return out
 
+    # -- value encoding ------------------------------------------------------
+
+    def encode(self, idx, value: Any, string_form: Optional[str] = None,
+               sprint_form: bool = False) -> None:
+        """Encode one scalar value at ``idx``.
+
+        ``sprint_form`` selects the operators' Go string form (gathers)
+        over the pattern walk's float formatting (slots).
+        """
+        if value is _MISSING:
+            self.tag[idx] = TAG_MISSING
+            return
+        if value is None:
+            self.tag[idx] = TAG_NULL
+            if self.milli is not None:
+                self.milli_ok[idx] = True
+            if self.nanos is not None:
+                self.nanos_ok[idx] = True
+            return
+        if isinstance(value, bool):
+            self.tag[idx] = TAG_BOOL
+            if self.milli is not None:
+                self.milli[idx] = 1000 if value else 0
+                self.milli_ok[idx] = True
+            if self.str_len is not None:
+                self._encode_str(idx, 'true' if value else 'false')
+            return
+        if isinstance(value, int):
+            self.tag[idx] = TAG_INT
+            if self.milli is not None and abs(value) <= _INT64_MAX // 1000:
+                self.milli[idx] = value * 1000
+                self.milli_ok[idx] = True
+            if self.str_len is not None:
+                self._encode_str(idx, str(value))
+                self.str_is_int[idx] = True
+                self.str_is_float[idx] = True
+            return
+        if isinstance(value, float):
+            self.tag[idx] = TAG_FLOAT
+            if self.milli is not None and math.isfinite(value):
+                frac = Fraction(str(value)) * 1000
+                if frac.denominator == 1 and abs(frac.numerator) <= _INT64_MAX:
+                    self.milli[idx] = int(frac)
+                    self.milli_ok[idx] = True
+            if self.str_len is not None:
+                self._encode_str(
+                    idx, _sprint(value) if sprint_form
+                    else _go_float_str(value))
+                self.str_is_float[idx] = True
+            return
+        if isinstance(value, str):
+            self.tag[idx] = TAG_STRING
+            if self.str_len is not None:
+                self._encode_str(idx, value)
+                try:
+                    int(value, 10)
+                    self.str_is_int[idx] = True
+                    self.str_is_float[idx] = True
+                except ValueError:
+                    try:
+                        float(value)
+                        self.str_is_float[idx] = True
+                    except ValueError:
+                        pass
+            if self.has_wild is not None:
+                self.has_wild[idx] = ('*' in value) or ('?' in value)
+            if self.milli is not None:
+                try:
+                    q = Quantity.parse(value)
+                except ValueError:
+                    pass
+                else:
+                    if self.str_is_qty is not None:
+                        self.str_is_qty[idx] = True
+                    m = q.value * 1000
+                    if m.denominator == 1 and abs(m.numerator) <= _INT64_MAX:
+                        self.milli[idx] = int(m)
+                        self.milli_ok[idx] = True
+            if self.nanos is not None:
+                try:
+                    ns = parse_duration(value)
+                except ValueError:
+                    pass
+                else:
+                    if self.str_is_dur is not None:
+                        self.str_is_dur[idx] = True
+                    self.nanos[idx] = ns
+                    self.nanos_ok[idx] = True
+            return
+        if isinstance(value, dict):
+            self.tag[idx] = TAG_MAP
+            return
+        if isinstance(value, list):
+            self.tag[idx] = TAG_ARRAY
+            return
+        self.tag[idx] = TAG_MISSING
+
+    def _encode_str(self, idx, s: str) -> None:
+        b = s.encode('utf-8')
+        self.str_len[idx] = len(b)
+        head = b[:STR_LEN]
+        self.str_head[idx][:len(head)] = np.frombuffer(head, np.uint8)
+        tail = b[-TAIL_LEN:]
+        self.str_tail[idx][TAIL_LEN - len(tail):] = \
+            np.frombuffer(tail, np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# need analysis: which lanes each slot/gather requires
+
+_STR_OPS = {'eq_str', 'prefix', 'suffix', 'min_len', 'nonempty', 'any_str',
+            'convertible', 'eq_int', 'eq_float', 'eq_null', 'wildcard'}
+_MILLI_OPS = {'eq_bool', 'eq_null', 'eq_int', 'eq_float', 'cmp_qty'}
+_NANOS_OPS = {'cmp_dur'}
+
+_ALL_NEEDS = frozenset({NEED_STR, NEED_MILLI, NEED_NANOS})
+
+
+def _analyze_needs(cps: CompiledPolicySet):
+    slot_needs: Dict[Slot, set] = {s: set() for s in cps.slots}
+    gather_needs: Dict[GatherSlot, set] = {g: set() for g in cps.gathers}
+    array_paths: set = set()
+
+    def visit_bool(expr):
+        if expr is None:
+            return
+        if expr.kind == 'leaf':
+            leaf = expr.leaf
+            n = slot_needs.setdefault(leaf.slot, set())
+            if leaf.op in _STR_OPS:
+                n.add(NEED_STR)
+            if leaf.op in _MILLI_OPS:
+                n.add(NEED_MILLI)
+            if leaf.op in _NANOS_OPS:
+                n.add(NEED_NANOS)
+            return
+        if expr.kind == 'cond':
+            g = expr.cond.gather
+            n = gather_needs.setdefault(g, set())
+            # conditions may compare strings (with wildcards both ways),
+            # quantities, and durations; encode everything they can read
+            n.update((NEED_STR, NEED_MILLI, NEED_NANOS, NEED_WILD))
+            return
+        for c in expr.children:
+            visit_bool(c)
+
+    def visit_status(node: StatusExpr):
+        if node is None:
+            return
+        visit_bool(node.expr)
+        if node.kind in ('forall', 'exists') and node.slot is not None:
+            array_paths.add(node.slot.path)
+        if node.sub is not None:
+            visit_status(node.sub)
+        for c in node.children:
+            visit_status(c)
+
+    for prog in cps.programs:
+        visit_status(prog.status)
+    return slot_needs, gather_needs, array_paths
+
+
+# ---------------------------------------------------------------------------
 
 def _walk(doc: Any, path: Tuple[str, ...]):
-    """Resolve a structural path; yields the value or a marker."""
     cur = doc
     for key in path:
-        if key == '*':
-            return cur  # caller handles element expansion
         if isinstance(cur, dict):
             if key not in cur:
                 return _MISSING
@@ -99,186 +281,177 @@ def _walk(doc: Any, path: Tuple[str, ...]):
     return cur
 
 
-_MISSING = object()
+class Batch:
+    def __init__(self, n: int):
+        self.n = n
+        self.slot_lanes: Dict[Slot, Lanes] = {}
+        self.array_meta: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
+        self.gather_lanes: Dict[GatherSlot, Lanes] = {}
+        self.gather_meta: Dict[GatherSlot, Dict[str, np.ndarray]] = {}
 
-
-_ALL_NEEDS = (True, True, True)
-
-
-def _encode_value(arrs: SlotArrays, idx, value: Any,
-                  need=_ALL_NEEDS) -> None:
-    t = arrs
-    need_str, need_milli, need_nanos = need
-    if value is _MISSING:
-        t.tag[idx] = TAG_MISSING
-        return
-    if value is None:
-        t.tag[idx] = TAG_NULL
-        t.milli_ok[idx] = True
-        t.nanos_ok[idx] = True
-        return
-    if isinstance(value, bool):
-        t.tag[idx] = TAG_BOOL
-        t.milli[idx] = 1000 if value else 0
-        t.milli_ok[idx] = True
-        if need_str:
-            _encode_str(t, idx, 'true' if value else 'false')
-        return
-    if isinstance(value, int):
-        t.tag[idx] = TAG_INT
-        if abs(value) <= _INT64_MAX // 1000:
-            t.milli[idx] = value * 1000
-            t.milli_ok[idx] = True
-        if need_str:
-            _encode_str(t, idx, str(value))
-        return
-    if isinstance(value, float):
-        t.tag[idx] = TAG_FLOAT
-        if need_milli and math.isfinite(value):
-            frac = Fraction(str(value)) * 1000
-            if frac.denominator == 1 and abs(frac.numerator) <= _INT64_MAX:
-                t.milli[idx] = int(frac)
-                t.milli_ok[idx] = True
-        if need_str:
-            _encode_str(t, idx, _go_float_str(value))
-        return
-    if isinstance(value, str):
-        t.tag[idx] = TAG_STRING
-        if need_str:
-            _encode_str(t, idx, value)
-            s = value
-            try:
-                int(s, 10)
-                t.str_is_int[idx] = True
-                t.str_is_float[idx] = True
-            except ValueError:
-                try:
-                    float(s)
-                    t.str_is_float[idx] = True
-                except ValueError:
-                    pass
-        if need_milli:
-            try:
-                q = Quantity.parse(value)
-                m = q.value * 1000
-                if m.denominator == 1 and abs(m.numerator) <= _INT64_MAX:
-                    t.milli[idx] = int(m)
-                    t.milli_ok[idx] = True
-            except ValueError:
-                pass
-        if need_nanos:
-            try:
-                t.nanos[idx] = parse_duration(value)
-                t.nanos_ok[idx] = True
-            except ValueError:
-                pass
-        return
-    if isinstance(value, dict):
-        t.tag[idx] = TAG_MAP
-        return
-    if isinstance(value, list):
-        t.tag[idx] = TAG_ARRAY
-        return
-    t.tag[idx] = TAG_MISSING
-
-
-def _encode_str(t: SlotArrays, idx, s: str) -> None:
-    b = s.encode('utf-8')
-    t.str_len[idx] = len(b)
-    head = b[:STR_LEN]
-    t.str_head[idx][:len(head)] = np.frombuffer(head, np.uint8)
-    tail = b[-TAIL_LEN:]
-    # right-aligned tail
-    t.str_tail[idx][TAIL_LEN - len(tail):] = np.frombuffer(tail, np.uint8)
-
-
-_STR_OPS = {'eq_str', 'prefix', 'suffix', 'min_len', 'nonempty', 'any_str',
-            'convertible', 'eq_int', 'eq_float'}
-_MILLI_OPS = {'eq_bool', 'eq_null', 'eq_int', 'eq_float', 'cmp_qty'}
-_NANOS_OPS = {'cmp_dur'}
-
-
-def _slot_needs(cps: CompiledPolicySet) -> Dict[Slot, Tuple[bool, bool, bool]]:
-    """Which channels each slot actually requires (str, milli, nanos)."""
-    cached = getattr(cps, '_slot_needs_cache', None)
-    if cached is not None:
-        return cached
-    needs: Dict[Slot, List[bool]] = {s: [False, False, False]
-                                     for s in cps.slots}
-
-    def visit(expr):
-        if expr is None:
-            return
-        if expr.kind == 'leaf':
-            leaf = expr.leaf
-            n = needs.setdefault(leaf.slot, [False, False, False])
-            if leaf.op in _STR_OPS:
-                n[0] = True
-            if leaf.op in _MILLI_OPS:
-                n[1] = True
-            if leaf.op in _NANOS_OPS:
-                n[2] = True
-        for c in expr.children:
-            visit(c)
-
-    for prog in cps.programs:
-        visit(prog.scalar)
-        visit(prog.scalar_condition)
-        for block in prog.elements:
-            visit(block.condition)
-            visit(block.constraint)
-    out = {s: tuple(v) for s, v in needs.items()}
-    cps._slot_needs_cache = out
-    return out
+    def tensors(self) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for i, (slot, lanes) in enumerate(self.slot_lanes.items()):
+            out.update(lanes.tensors(f's{i}'))
+        for j, (path, meta) in enumerate(self.array_meta.items()):
+            out[f'a{j}_count'] = meta['count']
+            out[f'a{j}_overflow'] = meta['overflow']
+            out[f'a{j}_tag'] = meta['tag']
+        for k, (g, lanes) in enumerate(self.gather_lanes.items()):
+            out.update(lanes.tensors(f'g{k}'))
+            meta = self.gather_meta[g]
+            out[f'g{k}_kind'] = meta['kind']
+            out[f'g{k}_count'] = meta['count']
+            out[f'g{k}_overflow'] = meta['overflow']
+        return out
 
 
 def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  padded_n: int = 0) -> Batch:
     n = max(len(resources), padded_n)
     batch = Batch(n)
-    needs = _slot_needs(cps)
-    # collect array paths used by element blocks
-    array_paths = set()
-    for prog in cps.programs:
-        for block in prog.elements:
-            array_paths.add(block.array_path)
-    for path in array_paths:
-        batch.arrays[path] = {
-            'arr_tag': np.zeros(n, np.int8),
-            'elem_count': np.zeros(n, np.int32),
-        }
-    for slot in cps.slots:
-        batch.slots[slot] = SlotArrays(n, slot.elem)
+    slot_needs, gather_needs, array_paths = _needs_cached(cps)
 
-    slot_plan = [(slot, arrs, needs.get(slot, (True, True, True)))
-                 for slot, arrs in batch.slots.items()]
+    # array metadata channels (count/overflow/tag) for forall/exists nodes
+    for path in array_paths:
+        depth = sum(1 for p in path if p == '*')
+        shape = (n,) + (MAX_ELEMS,) * depth
+        batch.array_meta[path] = {
+            'count': np.zeros(shape, np.int32),
+            'overflow': np.zeros(shape, bool),
+            'tag': np.zeros(shape, np.int8),
+        }
+
+    for slot in cps.slots:
+        shape = (n,) + (MAX_ELEMS,) * slot.depth
+        batch.slot_lanes[slot] = Lanes(shape, frozenset(slot_needs[slot]))
+
+    for g in cps.gathers:
+        batch.gather_lanes[g] = Lanes((n, MAX_GATHER),
+                                      frozenset(gather_needs[g]))
+        batch.gather_meta[g] = {
+            'kind': np.zeros(n, np.int8),
+            'count': np.zeros(n, np.int32),
+            'overflow': np.zeros(n, bool),
+        }
+
+    gather_progs = [(g, batch.gather_lanes[g], batch.gather_meta[g],
+                     _gather_searcher(g)) for g in cps.gathers]
+
+    slot_plan = _slot_plan(cps, batch)
     for r, doc in enumerate(resources):
-        for path, arrs in batch.arrays.items():
-            value = _walk(doc, path)
-            if value is _MISSING:
-                arrs['arr_tag'][r] = TAG_MISSING
-            elif isinstance(value, list):
-                arrs['arr_tag'][r] = TAG_ARRAY
-                arrs['elem_count'][r] = min(len(value), MAX_ELEMS)
-                if len(value) > MAX_ELEMS:
-                    # overflow: force host fallback by marking invalid
-                    arrs['arr_tag'][r] = TAG_MAP
-            else:
-                arrs['arr_tag'][r] = TAG_MAP  # wrong type → device FAIL
-        for slot, arrs, need in slot_plan:
-            if not slot.elem:
-                _encode_value(arrs, r, _walk(doc, slot.path), need)
-                continue
-            star = slot.path.index('*')
-            container = _walk(doc, slot.path[:star])
-            rest = slot.path[star + 1:]
-            if not isinstance(container, list):
-                continue  # stays MISSING; block-level arr_tag handles it
-            for e, elem in enumerate(container[:MAX_ELEMS]):
-                if rest:
-                    value = _walk(elem, rest) if isinstance(elem, dict) \
-                        else _MISSING
-                else:
-                    value = elem
-                _encode_value(arrs, (r, e), value, need)
+        _encode_doc(r, doc, slot_plan, batch)
+        for g, lanes, meta, searcher in gather_progs:
+            _encode_gather(r, doc, lanes, meta, searcher)
     return batch
+
+
+def _needs_cached(cps: CompiledPolicySet):
+    cached = getattr(cps, '_needs_cache', None)
+    if cached is None:
+        cached = _analyze_needs(cps)
+        cps._needs_cache = cached
+    return cached
+
+
+def _slot_plan(cps: CompiledPolicySet, batch: Batch):
+    """Group slots by their first array prefix so arrays are walked once."""
+    plan = []
+    for slot in cps.slots:
+        lanes = batch.slot_lanes[slot]
+        plan.append((slot, lanes))
+    return plan
+
+
+def _encode_doc(r: int, doc: dict, slot_plan, batch: Batch) -> None:
+    for path, meta in batch.array_meta.items():
+        _encode_array_meta(r, doc, path, meta)
+    for slot, lanes in slot_plan:
+        if slot.depth == 0:
+            lanes.encode(r, _walk(doc, slot.path))
+            continue
+        star1 = slot.path.index('*')
+        container = _walk(doc, slot.path[:star1])
+        rest1 = slot.path[star1 + 1:]
+        if not isinstance(container, list):
+            continue  # lanes stay TAG_MISSING; array guards handle it
+        if slot.depth == 1:
+            for e, elem in enumerate(container[:MAX_ELEMS]):
+                value = _walk(elem, rest1) if rest1 else elem
+                if rest1 and not isinstance(elem, dict):
+                    value = _MISSING
+                lanes.encode((r, e), value)
+        else:
+            star2 = rest1.index('*')
+            mid, rest2 = rest1[:star2], rest1[star2 + 1:]
+            for e, elem in enumerate(container[:MAX_ELEMS]):
+                inner = _walk(elem, mid) if isinstance(elem, dict) else _MISSING
+                if not isinstance(inner, list):
+                    continue
+                for e2, elem2 in enumerate(inner[:MAX_ELEMS]):
+                    value = elem2
+                    if rest2:
+                        value = _walk(elem2, rest2) \
+                            if isinstance(elem2, dict) else _MISSING
+                    lanes.encode((r, e, e2), value)
+
+
+def _encode_array_meta(r: int, doc: dict, path: Tuple[str, ...],
+                       meta: Dict[str, np.ndarray]) -> None:
+    depth = sum(1 for p in path if p == '*')
+    if depth == 0:
+        value = _walk(doc, path)
+        _set_array_meta(meta, r, value)
+        return
+    star1 = path.index('*')
+    container = _walk(doc, path[:star1])
+    rest = path[star1 + 1:]
+    if not isinstance(container, list):
+        return
+    for e, elem in enumerate(container[:MAX_ELEMS]):
+        value = _walk(elem, rest) if isinstance(elem, dict) else _MISSING
+        _set_array_meta(meta, (r, e), value)
+
+
+def _set_array_meta(meta, idx, value) -> None:
+    if value is _MISSING:
+        meta['tag'][idx] = TAG_MISSING
+    elif isinstance(value, list):
+        meta['tag'][idx] = TAG_ARRAY
+        meta['count'][idx] = min(len(value), MAX_ELEMS)
+        meta['overflow'][idx] = len(value) > MAX_ELEMS
+    elif value is None:
+        meta['tag'][idx] = TAG_NULL
+    elif isinstance(value, dict):
+        meta['tag'][idx] = TAG_MAP
+    else:
+        meta['tag'][idx] = TAG_STRING  # non-array scalar: guards only
+
+
+def _gather_searcher(g: GatherSlot):
+    from ..engine.jmespath import compile as jp_compile
+    compiled = jp_compile(g.expr)
+    return compiled
+
+
+def _encode_gather(r: int, doc: dict, lanes: Lanes, meta, searcher) -> None:
+    try:
+        result = searcher.search({'request': {'object': doc}})
+    except Exception:  # noqa: BLE001 - interpreter error → host decides
+        meta['kind'][r] = 0
+        meta['overflow'][r] = True
+        return
+    if result is None:
+        meta['kind'][r] = 0
+        return
+    if isinstance(result, list):
+        meta['kind'][r] = 2
+        meta['count'][r] = min(len(result), MAX_GATHER)
+        if len(result) > MAX_GATHER:
+            meta['overflow'][r] = True
+        for e, value in enumerate(result[:MAX_GATHER]):
+            lanes.encode((r, e), value, sprint_form=True)
+        return
+    meta['kind'][r] = 1
+    meta['count'][r] = 1
+    lanes.encode((r, 0), result, sprint_form=True)

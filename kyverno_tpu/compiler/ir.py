@@ -1,4 +1,4 @@
-"""Compiler IR: policies → slot table + vectorized check programs.
+"""Compiler IR v2: policies → slot table + tri-state status programs.
 
 The TPU execution model replaces the reference's per-resource tree-walk
 interpreter (reference: pkg/engine/validate/validate.go) with trace-time
@@ -6,18 +6,24 @@ specialization:
 
 * a **slot** is a policy-relevant structural path (e.g.
   ``spec.containers.*.image``); resources are *projected* onto the slot
-  table at encode time — the document itself never reaches the device
-* a **leaf check** is a scalar predicate on one slot, chosen from a closed
-  vectorizable vocabulary (string classes, numeric/quantity/duration
-  comparisons, existence, bool/null equality)
-* a **rule program** is a small boolean tree over leaf checks with
-  tri-state (pass/fail/skip) element semantics mirroring the anchor rules
-* anything outside the vocabulary is compiled to HOST_FALLBACK and runs on
-  the host engine; the device result for such rules is ignored
+  table at encode time — the document itself never reaches the device.
+  Paths may contain up to two ``'*'`` array traversals (e.g.
+  ``spec.containers.*.ports.*.hostPort``).
+* a **gather slot** collects a flattened list of scalars addressed by a
+  JMESPath shape (field chains, ``[]`` flattens, multiselect lists,
+  ``keys(@)``, ``|| <literal>`` fallbacks) — the device form of deny /
+  precondition condition keys over ``request.object``.
+* a **leaf check** is a scalar predicate on one slot from a closed
+  vectorizable vocabulary; a **condition check** is one reference
+  condition operator applied to a gather slot.
+* a **status expression** is a tree mirroring the anchor walk with
+  tri-state semantics (PASS / FAIL / SKIP), evaluated under Kleene
+  three-valued logic so any undecidable leaf yields UNKNOWN → the rule is
+  re-run on the host engine for that resource (exactness is never lost).
 
 Because programs are Python constants closed over by the jitted evaluator,
-XLA sees straight-line fused elementwise ops over ``[R, E]`` tensors — no
-interpreter loop on device.
+XLA sees straight-line fused elementwise ops over ``[R]``/``[R, E]``
+tensors — no interpreter loop on device.
 """
 
 from __future__ import annotations
@@ -39,8 +45,13 @@ TAG_ARRAY = 7
 STR_LEN = 64
 # bytes kept from the end of each string (right-aligned suffix window)
 TAIL_LEN = 16
-# maximum array elements encoded per element-bearing slot
+# maximum array elements encoded per element-bearing slot dimension
 MAX_ELEMS = 16
+# maximum elements per gather slot (flattened JMESPath projections)
+MAX_GATHER = 32
+
+# device status codes (STATUS_HOST = undecidable on device → host fallback)
+STATUS_PASS, STATUS_FAIL, STATUS_SKIP, STATUS_HOST = 0, 1, 2, 3
 
 
 @dataclass(frozen=True)
@@ -48,31 +59,59 @@ class Slot:
     """A policy-relevant structural path.
 
     ``path`` is a tuple of keys; ``'*'`` marks an array-of-maps traversal.
-    At most one ``'*'`` is supported in the vectorized path (deeper nesting
-    falls back to host). ``elem`` is True when the slot has an element
-    dimension.
+    Up to two ``'*'`` levels are vectorized (deeper nesting falls back to
+    host). ``depth`` is the number of element dimensions.
     """
     path: Tuple[str, ...]
 
     @property
+    def depth(self) -> int:
+        return sum(1 for p in self.path if p == '*')
+
+    @property
     def elem(self) -> bool:
-        return '*' in self.path
+        return self.depth > 0
 
     def __str__(self):
         return '.'.join(self.path)
 
+
+# --- gather programs (JMESPath shapes) -------------------------------------
+
+@dataclass(frozen=True)
+class GatherSlot:
+    """A scalar-or-list value gathered from the resource document.
+
+    ``expr`` is the raw JMESPath condition key (braces stripped).  The
+    compiler only admits shapes whose semantics the encoder can represent
+    (field chains over ``request.object``, ``[]`` flatten projections,
+    field multiselect lists, ``keys(@)``, ``|| <literal>``); at encode
+    time the expression is evaluated verbatim by the in-repo JMESPath
+    interpreter against ``{'request': {'object': doc}}``, so gather
+    semantics are host-exact by construction.
+    """
+    expr: str
+
+    def __str__(self):
+        return self.expr
+
+
+# --- leaf checks ------------------------------------------------------------
 
 # Leaf-check op vocabulary — the single source of truth; the compiler emits
 # exactly these strings and ops/eval.py implements exactly this set.
 LEAF_OPS = frozenset({
     'true',         # constant pass
     'absent',       # key missing (X() negation anchors)
+    'present',      # key exists in parent map (anchor presence tests)
     'star',         # "*": key present and non-null
+    'is_map',       # structural guard: value is a map
+    'is_array',     # structural guard: value is an array
     'any_str',      # wildcard "*" string compare: any string-convertible
     'nonempty',     # "?*": non-empty string form
     'convertible',  # value has a string form (guards NotEqual)
     'eq_bool',      # operand: bool
-    'eq_null',
+    'eq_null',      # null pattern: null/0/"" match (missing treated as null)
     'eq_int',       # operand: int
     'eq_float',     # operand: float (milli-exact)
     'cmp_qty',      # operand: (cmp, milli int)
@@ -81,6 +120,7 @@ LEAF_OPS = frozenset({
     'prefix',       # operand: str (≤ STR_LEN bytes)
     'suffix',       # operand: str (≤ TAIL_LEN bytes)
     'min_len',      # operand: int (byte length lower bound)
+    'wildcard',     # operand: str pattern with */?; DP over the byte window
 })
 
 CMP_GT, CMP_GE, CMP_LT, CMP_LE, CMP_EQ, CMP_NE = '>', '>=', '<', '<=', '==', '!='
@@ -92,21 +132,45 @@ class Leaf:
     slot: Slot
     op: str
     operand: Any = None
-    # missing key fails the check unless the leaf is under an equality
-    # anchor (=(key): missing passes) — the compiler folds that in here
+    # missing key passes the check (=(key) equality anchors fold this in)
     missing_ok: bool = False
 
 
 @dataclass(frozen=True)
+class CondCheck:
+    """One compiled deny/precondition condition over a gather slot.
+
+    ``op`` is the lower-cased reference operator name; ``values`` is the
+    constant operand list (scalars normalized to their Go string form at
+    compile time where applicable). Semantics mirror
+    kyverno_tpu/engine/operators.py (reference:
+    pkg/engine/variables/operator/*.go).
+    """
+    gather: GatherSlot
+    op: str                      # 'anyin' | 'allin' | 'anynotin' | 'allnotin'
+                                 # | 'equals' | 'notequals' | numeric cmps
+    values: Tuple[Any, ...]
+    # True when the condition value was a YAML list (vs a bare scalar) —
+    # the reference dispatches on the value's type, not just its contents
+    list_value: bool = False
+
+
+@dataclass(frozen=True)
 class BoolExpr:
-    """AND/OR/NOT tree over leaves (within one element scope)."""
-    kind: str                      # 'leaf' | 'and' | 'or' | 'not'
+    """AND/OR/NOT tree over leaves / condition checks (Kleene 3-valued on
+    device: each node evaluates to (true-known, false-known))."""
+    kind: str                      # 'leaf' | 'cond' | 'and' | 'or' | 'not'
     leaf: Optional[Leaf] = None
+    cond: Optional[CondCheck] = None
     children: Tuple['BoolExpr', ...] = ()
 
     @staticmethod
     def of(leaf: Leaf) -> 'BoolExpr':
         return BoolExpr('leaf', leaf=leaf)
+
+    @staticmethod
+    def of_cond(cond: CondCheck) -> 'BoolExpr':
+        return BoolExpr('cond', cond=cond)
 
     @staticmethod
     def all(children: List['BoolExpr']) -> 'BoolExpr':
@@ -125,43 +189,81 @@ class BoolExpr:
         return BoolExpr('not', children=(child,))
 
 
+# --- status expressions -----------------------------------------------------
+
 @dataclass(frozen=True)
-class ElementBlock:
-    """Per-element tri-state semantics for one array pattern.
+class StatusExpr:
+    """Tri-state node mirroring one step of the validate walk.
 
-    ``mode='forall'`` (reference: pkg/engine/validate/validate.go:218
-    validateArrayOfMaps): per element, if ``condition`` fails → element
-    SKIP; else ``constraint`` must hold → else FAIL. Rule-level: any FAIL →
-    fail; no FAIL and applyCount==0 with skips → skip. A missing/non-array
-    value fails.
+    kinds and semantics (reference: pkg/engine/validate/validate.go +
+    pkg/engine/anchor/handlers.go):
 
-    ``mode='exists'`` (reference: pkg/engine/anchor/handlers.go:228
-    existence anchor): at least one element must satisfy ``constraint``;
-    an empty array fails, a missing key passes.
+      const     — constant status (operand = status code)
+      leaf      — BoolExpr ``expr``: True → PASS, False → FAIL
+      seq       — children in walk order; first non-PASS child decides
+      cond      — (k) condition anchor: key absent → SKIP; present and
+                  ``sub`` non-PASS → SKIP; else PASS   (handlers.go:31)
+      global    — <(k): key absent → PASS; present and ``sub`` non-PASS →
+                  SKIP                                  (handlers.go:??)
+      equality  — =(k): key absent → PASS; else ``sub`` status as-is
+      negation  — X(k): key present → FAIL; absent → PASS
+      exists    — ^(k): key absent → PASS; non-array → FAIL; else at least
+                  one element with ``sub``==PASS → PASS else FAIL
+                  (handlers.go:228; inner skips count as non-match)
+      forall    — array-of-maps walk (validate.go:218): non-array → FAIL;
+                  any element FAIL → FAIL; 0 applied & >0 skips → SKIP;
+                  else PASS.  ``sub`` is evaluated per element.
+      scalars   — scalar pattern vs array value (validate.go:71 case):
+                  non-array handled by plain leaf; for arrays every element
+                  must satisfy ``expr``
+      deny      — ``expr`` True → FAIL (operand carries nothing)
+      precond   — ``expr`` False → SKIP, else PASS (preconditions gate)
+      any       — anyPattern: any child PASS → PASS; else all children
+                  SKIP → SKIP; else FAIL  (engine.py validate_any_pattern)
+
+    ``slot`` is the anchored key's slot for presence tests (cond/global/
+    equality/negation/exists) or the array node slot (forall).
     """
-    array_path: Tuple[str, ...]
-    condition: Optional[BoolExpr]   # None = unconditional
-    constraint: BoolExpr
-    mode: str = 'forall'
+    kind: str
+    slot: Optional[Slot] = None
+    expr: Optional[BoolExpr] = None
+    sub: Optional['StatusExpr'] = None
+    children: Tuple['StatusExpr', ...] = ()
+    operand: Any = None
+
+    @staticmethod
+    def const(status: int) -> 'StatusExpr':
+        return StatusExpr('const', operand=status)
+
+    @staticmethod
+    def seq(children: List['StatusExpr']) -> 'StatusExpr':
+        flat: List[StatusExpr] = []
+        for c in children:
+            if c.kind == 'seq':
+                flat.extend(c.children)
+            elif c.kind == 'const' and c.operand == STATUS_PASS:
+                continue
+            else:
+                flat.append(c)
+        if not flat:
+            return StatusExpr.const(STATUS_PASS)
+        if len(flat) == 1:
+            return flat[0]
+        return StatusExpr('seq', children=tuple(flat))
 
 
 @dataclass(frozen=True, eq=False)
 class RuleProgram:
-    """One compiled rule."""
+    """One compiled rule: a status expression per resource."""
     policy_name: str
     rule_name: str
     policy_index: int
     rule_index: int
-    # scalar (non-element) constraints, all must hold
-    scalar: Optional[BoolExpr]
-    # map-level conditional anchors: all must hold else rule SKIP
-    scalar_condition: Optional[BoolExpr]
-    # element blocks (array-of-maps), each contributes tri-state
-    elements: Tuple[ElementBlock, ...]
+    status: StatusExpr
     # static pass message (compile-time constant)
     pass_message: str
     background: bool = True
-    # the original rule dict (for host-side match evaluation)
+    # the original rule dict (for host-side match evaluation + fallback)
     rule_raw: Optional[dict] = None
 
 
@@ -170,10 +272,11 @@ class CompiledPolicySet:
     """Output of the compiler for a policy set."""
     slots: List[Slot] = field(default_factory=list)
     slot_index: Dict[Slot, int] = field(default_factory=dict)
+    gathers: List[GatherSlot] = field(default_factory=list)
+    gather_index: Dict[GatherSlot, int] = field(default_factory=dict)
     programs: List[RuleProgram] = field(default_factory=list)
     # (policy_index, rule dict, policy) for rules the device cannot evaluate
     host_rules: List[Tuple[int, dict, Any]] = field(default_factory=list)
-    # per-policy kind → rule match precomputation inputs
     policies: List[Any] = field(default_factory=list)
 
     def slot_id(self, slot: Slot) -> int:
@@ -181,6 +284,12 @@ class CompiledPolicySet:
             self.slot_index[slot] = len(self.slots)
             self.slots.append(slot)
         return self.slot_index[slot]
+
+    def gather_id(self, g: GatherSlot) -> int:
+        if g not in self.gather_index:
+            self.gather_index[g] = len(self.gathers)
+            self.gathers.append(g)
+        return self.gather_index[g]
 
 
 class CompileError(Exception):

@@ -1,0 +1,15 @@
+"""Pod Security Standards → device rule library (placeholder this commit).
+
+Will compile ``validate.podSecurity`` rules into the gather/condition
+vocabulary (reference: pkg/pss/evaluate.go); until then PSS rules fall
+back to the host evaluator.
+"""
+
+from __future__ import annotations
+
+from .ir import CompileError, CompiledPolicySet, StatusExpr
+
+
+def compile_pod_security(cps: CompiledPolicySet,
+                         pod_security: dict) -> StatusExpr:
+    raise CompileError('podSecurity device library not yet enabled')
