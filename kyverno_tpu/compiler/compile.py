@@ -74,15 +74,24 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
 
     name = rule.get('name', '')
     units: List[StatusExpr] = []
+    pass_messages = (f"validation rule '{name}' passed.",)
+    error_messages: List[str] = []
 
     # preconditions gate everything (engine.py Validator.validate order)
     if rule.get('preconditions') is not None:
         pre = _compile_conditions(cps, rule['preconditions'])
-        units.append(StatusExpr('precond', expr=pre))
+        plan = _error_plan(cps, rule['preconditions'],
+                           'failed to evaluate preconditions', error_messages)
+        units.append(StatusExpr('precond', expr=pre, operand=plan))
 
     if validate.get('deny') is not None:
-        deny = _compile_conditions(cps, (validate['deny'] or {}).get('conditions'))
-        units.append(StatusExpr('deny', expr=deny))
+        conditions = (validate['deny'] or {}).get('conditions')
+        deny = _compile_conditions(cps, conditions)
+        plan = _error_plan(
+            cps, conditions,
+            'failed to substitute variables in deny conditions',
+            error_messages)
+        units.append(StatusExpr('deny', expr=deny, operand=plan))
     elif validate.get('pattern') is not None:
         units.append(_compile_pattern_status(cps, validate['pattern']))
     elif validate.get('anyPattern') is not None:
@@ -92,6 +101,11 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         children = [_compile_pattern_status(cps, p, in_any_pattern=True)
                     for p in pats]
         units.append(StatusExpr('any', children=tuple(children)))
+        # pass message carries the index of the sub-pattern that matched
+        # (engine.py:514, reference: pkg/engine/validation.go:640)
+        pass_messages = tuple(
+            f"validation rule '{name}' anyPattern[{i}] passed."
+            for i in range(len(pats)))
     elif validate.get('podSecurity') is not None:
         from .pss_compile import compile_pod_security
         units.append(compile_pod_security(cps, validate['podSecurity']))
@@ -102,8 +116,43 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         policy_name=policy.name, rule_name=name,
         policy_index=p_idx, rule_index=r_idx,
         status=StatusExpr.seq(units),
-        pass_message=f"validation rule '{name}' passed.",
+        pass_messages=pass_messages,
+        error_messages=tuple(error_messages),
         background=policy.background, rule_raw=rule)
+
+
+def _error_plan(cps: CompiledPolicySet, conditions: Any, prefix: str,
+                messages: List[str]) -> Tuple[Tuple[GatherSlot, int], ...]:
+    """Ordered (gather, message-index) plan for unresolvable condition
+    variables.  Mirrors the substitution traversal order
+    (variables.py _traverse, reference: pkg/engine/jsonutils/traverse.go)
+    so the first missing variable produces the host's exact
+    substitution-error message (engine.py:388,431)."""
+    leaves: List[Tuple[str, str]] = []
+
+    def walk(node: Any, path: str) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f'{path}/{k}')
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                walk(v, f'{path}/{i}')
+        elif isinstance(node, str):
+            m = _SINGLE_VAR_RE.match(node.strip())
+            if m:
+                leaves.append((m.group(1).strip(), path))
+
+    walk(conditions, '')
+    plan: List[Tuple[GatherSlot, int]] = []
+    for var, path in leaves:
+        gather = GatherSlot(var)
+        if gather not in cps.gather_index:
+            raise CompileError(f'unplanned variable {var!r} in conditions')
+        messages.append(
+            f'{prefix}: failed to resolve {var} at path {path}: '
+            f'Unknown key "{var}" in path')
+        plan.append((gather, len(messages) - 1))
+    return tuple(plan)
 
 
 # ---------------------------------------------------------------------------
@@ -268,9 +317,11 @@ def _compile_element(cps: CompiledPolicySet, pattern: Any,
             return StatusExpr.seq([is_arr, forall])
         if isinstance(first, (str, int, float, bool)) or first is None:
             # scalar array pattern: every element must match the scalar
-            # (validate.go:177 routes the whole array into the scalar leaf)
-            check = _compile_leaf(cps, path, first)
-            return StatusExpr.seq([is_arr, StatusExpr('leaf', expr=check)])
+            # (validate.go:104 routes the array through the scalar leaf,
+            # validate_pattern.py:61-66 checks each element)
+            check = _compile_leaf(cps, path + ('*',), first)
+            return StatusExpr.seq(
+                [is_arr, StatusExpr('scalars', slot=slot, expr=check)])
         raise CompileError('typed array patterns not vectorized')
     if isinstance(pattern, (str, int, float, bool)) or pattern is None:
         return StatusExpr('leaf', expr=_compile_leaf(cps, path, pattern))
@@ -316,6 +367,8 @@ def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...],
     if pattern is None:
         return L('eq_null')
     if isinstance(pattern, int):
+        if abs(pattern) * 1000 > (1 << 63) - 1:
+            raise CompileError('integer pattern exceeds the milli lane')
         return L('eq_int', pattern)
     if isinstance(pattern, float):
         milli = Fraction(str(pattern)) * 1000
@@ -330,9 +383,11 @@ def _compile_leaf(cps: CompiledPolicySet, path: Tuple[str, ...],
 def _compile_string_pattern(slot: Slot, pattern: str) -> BoolExpr:
     """Compile the string operator grammar
     (reference: pkg/engine/pattern/pattern.go:152 validateStringPatterns)."""
-    if pattern == '*':
-        return BoolExpr.of(Leaf(slot, 'star'))
+    # the host short-circuits when the value equals the whole pattern
+    # string literally (pattern.py:133) — e.g. value '>5' vs pattern '>5'
     ors = []
+    if len(pattern.encode('utf-8')) <= STR_LEN:
+        ors.append(BoolExpr.of(Leaf(slot, 'eq_str', pattern)))
     for condition in pattern.split('|'):
         ands = []
         for term in condition.strip(' ').split('&'):
@@ -428,9 +483,11 @@ def _compile_wildcard_eq(slot: Slot, operand: str) -> BoolExpr:
 # ---------------------------------------------------------------------------
 # Condition compilation (deny / preconditions)
 
+# the deprecated In/NotIn have enough extra quirks (strict string slices,
+# _set_in json semantics) that they stay host-side
 _SUPPORTED_COND_OPS = {
     'equal', 'equals', 'notequal', 'notequals',
-    'in', 'anyin', 'allin', 'notin', 'anynotin', 'allnotin',
+    'anyin', 'allin', 'anynotin', 'allnotin',
     'greaterthanorequals', 'greaterthan', 'lessthanorequals', 'lessthan',
 }
 
@@ -495,13 +552,15 @@ def _compile_condition(cps: CompiledPolicySet, cond: Any) -> BoolExpr:
         list_value=isinstance(value, list)))
 
 
-def _check_constant(value: Any) -> None:
-    """Condition values must be variable-free constants."""
+def _check_constant(value: Any, top: bool = True) -> None:
+    """Condition values must be flat, variable-free constants."""
     if isinstance(value, str) and (is_variable(value) or is_reference(value)):
         raise CompileError(f'variable in condition value: {value!r}')
     if isinstance(value, list):
+        if not top:
+            raise CompileError('nested list condition value not vectorized')
         for v in value:
-            _check_constant(v)
+            _check_constant(v, top=False)
     if isinstance(value, dict):
         raise CompileError('map-typed condition value not vectorized')
 

@@ -79,17 +79,17 @@ class Lanes:
         self.milli_ok = zb() if NEED_MILLI in needs else None
         self.nanos = z64() if NEED_NANOS in needs else None
         self.nanos_ok = zb() if NEED_NANOS in needs else None
+        # the string-parse flags ride with whichever numeric/string bundle
+        # reads them (cmp_qty gates on str_is_qty without string lanes)
+        self.str_is_int = zb() if needs & {NEED_STR, NEED_MILLI} else None
+        self.str_is_float = zb() if needs & {NEED_STR, NEED_MILLI} else None
+        self.str_is_qty = zb() if NEED_MILLI in needs else None
+        self.str_is_dur = zb() if NEED_NANOS in needs else None
         if NEED_STR in needs:
-            self.str_is_int = zb()
-            self.str_is_float = zb()
-            self.str_is_qty = zb()
-            self.str_is_dur = zb()
             self.str_len = np.zeros(shape, np.int32)
             self.str_head = np.zeros(shape + (STR_LEN,), np.uint8)
             self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8)
         else:
-            self.str_is_int = self.str_is_float = None
-            self.str_is_qty = self.str_is_dur = None
             self.str_len = self.str_head = self.str_tail = None
         self.has_wild = zb() if NEED_WILD in needs else None
 
@@ -137,8 +137,12 @@ class Lanes:
             if self.milli is not None and abs(value) <= _INT64_MAX // 1000:
                 self.milli[idx] = value * 1000
                 self.milli_ok[idx] = True
+            if self.nanos is not None and value == 0:
+                # _number_to_string(0) == '0' parses as Go duration 0
+                self.nanos_ok[idx] = True
             if self.str_len is not None:
                 self._encode_str(idx, str(value))
+            if self.str_is_int is not None:
                 self.str_is_int[idx] = True
                 self.str_is_float[idx] = True
             return
@@ -153,12 +157,14 @@ class Lanes:
                 self._encode_str(
                     idx, _sprint(value) if sprint_form
                     else _go_float_str(value))
+            if self.str_is_float is not None:
                 self.str_is_float[idx] = True
             return
         if isinstance(value, str):
             self.tag[idx] = TAG_STRING
             if self.str_len is not None:
                 self._encode_str(idx, value)
+            if self.str_is_int is not None:
                 try:
                     int(value, 10)
                     self.str_is_int[idx] = True
@@ -175,7 +181,16 @@ class Lanes:
                 try:
                     q = Quantity.parse(value)
                 except ValueError:
-                    pass
+                    # int()-parseable strings the quantity grammar rejects
+                    # (' 5', '5_0') still feed eq_int via the milli lane
+                    try:
+                        iv = int(value, 10)
+                    except ValueError:
+                        pass
+                    else:
+                        if abs(iv) <= _INT64_MAX // 1000:
+                            self.milli[idx] = iv * 1000
+                            self.milli_ok[idx] = True
                 else:
                     if self.str_is_qty is not None:
                         self.str_is_qty[idx] = True
@@ -191,8 +206,11 @@ class Lanes:
                 else:
                     if self.str_is_dur is not None:
                         self.str_is_dur[idx] = True
-                    self.nanos[idx] = ns
-                    self.nanos_ok[idx] = True
+                    # str_is_dur without nanos_ok = parsed but out of the
+                    # int64 lane → undecidable on device
+                    if abs(ns) <= _INT64_MAX:
+                        self.nanos[idx] = ns
+                        self.nanos_ok[idx] = True
             return
         if isinstance(value, dict):
             self.tag[idx] = TAG_MAP
@@ -255,7 +273,8 @@ def _analyze_needs(cps: CompiledPolicySet):
         if node is None:
             return
         visit_bool(node.expr)
-        if node.kind in ('forall', 'exists') and node.slot is not None:
+        if node.kind in ('forall', 'exists', 'scalars') and \
+                node.slot is not None:
             array_paths.add(node.slot.path)
         if node.sub is not None:
             visit_status(node.sub)
@@ -264,7 +283,28 @@ def _analyze_needs(cps: CompiledPolicySet):
 
     for prog in cps.programs:
         visit_status(prog.status)
-    return slot_needs, gather_needs, array_paths
+        # trackfail guards reduce element-scoped presence tests over the
+        # containers along the slot path — those need count/overflow too
+        def visit_guards(node: StatusExpr):
+            if node is None:
+                return
+            if node.kind == 'trackfail' and node.expr is not None:
+                def leaf_paths(e):
+                    if e.kind == 'leaf' and e.leaf.slot.elem:
+                        path = e.leaf.slot.path
+                        for i, p in enumerate(path):
+                            if p == '*':
+                                array_paths.add(path[:i])
+                    for c in e.children:
+                        leaf_paths(c)
+                leaf_paths(node.expr)
+            if node.sub is not None:
+                visit_guards(node.sub)
+            for c in node.children:
+                visit_guards(c)
+        visit_guards(prog.status)
+    # deterministic order shared by the encoder and the evaluator
+    return slot_needs, gather_needs, sorted(array_paths)
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +343,7 @@ class Batch:
             out[f'g{k}_kind'] = meta['kind']
             out[f'g{k}_count'] = meta['count']
             out[f'g{k}_overflow'] = meta['overflow']
+            out[f'g{k}_notfound'] = meta['notfound']
         return out
 
 
@@ -333,6 +374,7 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
             'kind': np.zeros(n, np.int8),
             'count': np.zeros(n, np.int32),
             'overflow': np.zeros(n, bool),
+            'notfound': np.zeros(n, bool),
         }
 
     gather_progs = [(g, batch.gather_lanes[g], batch.gather_meta[g],
@@ -435,8 +477,15 @@ def _gather_searcher(g: GatherSlot):
 
 
 def _encode_gather(r: int, doc: dict, lanes: Lanes, meta, searcher) -> None:
+    from ..engine.jmespath import NotFoundError
     try:
         result = searcher.search({'request': {'object': doc}})
+    except NotFoundError:
+        # missing path → the host's deterministic substitution-error ERROR
+        # (engine.py:388; synthesized on device via STATUS_VAR_ERR)
+        meta['kind'][r] = 0
+        meta['notfound'][r] = True
+        return
     except Exception:  # noqa: BLE001 - interpreter error → host decides
         meta['kind'][r] = 0
         meta['overflow'][r] = True

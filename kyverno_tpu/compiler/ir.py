@@ -50,8 +50,15 @@ MAX_ELEMS = 16
 # maximum elements per gather slot (flattened JMESPath projections)
 MAX_GATHER = 32
 
-# device status codes (STATUS_HOST = undecidable on device → host fallback)
+# device status codes (STATUS_HOST = undecidable on device → host fallback;
+# STATUS_SKIP_PRECOND = skipped by preconditions, whose message is the
+# static 'preconditions not met'; STATUS_VAR_ERR = a condition variable
+# failed to resolve — the host's deterministic substitution-error ERROR,
+# message indexed by ``detail`` into RuleProgram.error_messages)
 STATUS_PASS, STATUS_FAIL, STATUS_SKIP, STATUS_HOST = 0, 1, 2, 3
+STATUS_SKIP_PRECOND = 4
+STATUS_VAR_ERR = 5
+N_STATUS_CODES = 6
 
 
 @dataclass(frozen=True)
@@ -260,8 +267,13 @@ class RuleProgram:
     policy_index: int
     rule_index: int
     status: StatusExpr
-    # static pass message (compile-time constant)
-    pass_message: str
+    # static pass messages (compile-time constants); anyPattern rules carry
+    # one per sub-pattern, indexed by the evaluator's ``detail`` output
+    # (reference message format: pkg/engine/validation.go:640)
+    pass_messages: Tuple[str, ...]
+    # substitution-error messages for unresolvable condition variables,
+    # indexed by ``detail`` on STATUS_VAR_ERR (engine.py:388-391,431-434)
+    error_messages: Tuple[str, ...] = ()
     background: bool = True
     # the original rule dict (for host-side match evaluation + fallback)
     rule_raw: Optional[dict] = None

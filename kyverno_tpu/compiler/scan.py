@@ -7,12 +7,15 @@ pkg/controllers/report/utils/scanner.go:60 ScanResource):
 1. compile the policy set once (``compile_policies``)
 2. project each resource onto the slot table (``encode_batch``)
 3. run the jitted evaluator — a verdict sieve over [resources × rules]
-4. synthesize responses for PASS verdicts from compile-time templates;
-   re-materialize non-pass / host-fallback results with the host engine so
-   messages and statuses are bit-identical to a pure host run
+4. synthesize responses for PASS / precondition-SKIP verdicts from
+   compile-time templates; re-materialize FAIL / anchor-SKIP / HOST
+   results with the host engine so messages and statuses are always
+   bit-identical to a pure host run
 
-Match/exclude is precomputed host-side with a (kind, apiVersion, namespace)
-cache, since most background-scan policies match on kinds alone.
+Match/exclude is evaluated once per (kind, apiVersion, namespace) group
+for rules whose match blocks only reference those fields — the common
+case for background-scan policies — instead of once per (resource, rule)
+pair (reference match semantics: pkg/engine/utils.go:185).
 """
 
 from __future__ import annotations
@@ -24,16 +27,19 @@ import numpy as np
 
 from ..api.policy import Policy, Rule
 from ..api.unstructured import Resource
-from ..engine.api import EngineResponse, PolicyContext, RuleResponse, RuleStatus, RuleType
+from ..engine.api import (EngineResponse, PolicyContext, RuleResponse,
+                          RuleStatus, RuleType)
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
 from .compile import compile_policies
 from .encode import encode_batch
-from .ir import CompiledPolicySet, RuleProgram
-
-STATUS_NAMES = {0: RuleStatus.PASS, 1: RuleStatus.FAIL, 2: RuleStatus.SKIP}
+from .ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
+                 STATUS_SKIP_PRECOND, STATUS_VAR_ERR, CompiledPolicySet,
+                 RuleProgram)
 
 _SIMPLE_MATCH_KEYS = {'kinds', 'namespaces', 'operations'}
+
+PRECONDITIONS_SKIP_MESSAGE = 'preconditions not met'
 
 
 def _rule_match_is_simple(rule: dict) -> bool:
@@ -50,88 +56,212 @@ def _rule_match_is_simple(rule: dict) -> bool:
         block_simple(rule.get('exclude') or {})
 
 
+def _group_key(doc: dict) -> Tuple[str, str, str]:
+    meta = doc.get('metadata') or {}
+    return (str(doc.get('kind', '')), str(doc.get('apiVersion', '')),
+            str(meta.get('namespace', '') or ''))
+
+
 class BatchScanner:
+    """Compiles a policy set once and evaluates resource batches on device.
+
+    ``scan`` returns the full per-resource engine responses (bit-identical
+    to the host engine); ``scan_statuses`` returns just the raw device
+    verdict matrices for throughput-critical callers.
+    """
+
     def __init__(self, policies: List[Policy], engine: Optional[Engine] = None,
                  mesh=None):
         self.policies = policies
         self.engine = engine or Engine()
         self.cps: CompiledPolicySet = compile_policies(policies)
+        self.mesh = mesh
+        # policies needing the host engine for at least one rule, plus
+        # applyRules=One policies (early-exit coupling between rules)
+        self._host_policy_idx = sorted(
+            {i for i, _, _ in self.cps.host_rules} |
+            {i for i, p in enumerate(policies)
+             if (p.apply_rules or 'All') == 'One'})
+        host_set = set(self._host_policy_idx)
+        # device-synthesizable programs (their whole policy compiled)
+        self.device_programs: List[Tuple[int, RuleProgram]] = [
+            (j, prog) for j, prog in enumerate(self.cps.programs)
+            if prog.policy_index not in host_set]
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
-        self.mesh = mesh
-        self._match_cache: Dict[Tuple, bool] = {}
         self._simple_match = [
             _rule_match_is_simple(p.rule_raw or {}) for p in self.cps.programs]
-        # policies that have at least one host-fallback rule
-        self._host_policy_idx = sorted({i for i, _, _ in self.cps.host_rules})
+        self._match_cache: Dict[Tuple, np.ndarray] = {}
+        self._rules = [Rule(p.rule_raw or {}) for p in self.cps.programs]
 
     # -- match --------------------------------------------------------------
 
-    def _matches(self, prog_idx: int, prog: RuleProgram,
-                 resource: Resource) -> bool:
-        rule = Rule(prog.rule_raw or {})
-        policy = self.policies[prog.policy_index]
-        if self._simple_match[prog_idx]:
-            key = (prog.policy_index, prog.rule_index, resource.kind,
-                   resource.api_version, resource.namespace)
-            cached = self._match_cache.get(key)
-            if cached is not None:
-                return cached
-            result = matches_resource_description(
-                resource, rule, None, [], {}, policy.namespace) is None
-            self._match_cache[key] = result
-            return result
-        return matches_resource_description(
-            resource, rule, None, [], {}, policy.namespace) is None
+    def _policy_gate(self, policy: Policy, res: Resource) -> bool:
+        """Namespaced policies only apply inside their own namespace
+        (engine.py:230-236, reference: pkg/engine/validation.go:117)."""
+        if not policy.is_namespaced:
+            return True
+        return bool(res.namespace) and res.namespace == policy.namespace
 
-    # -- scan ---------------------------------------------------------------
+    def _match_one(self, j: int, res: Resource) -> bool:
+        prog = self.cps.programs[j]
+        policy = self.policies[prog.policy_index]
+        if not self._policy_gate(policy, res):
+            return False
+        return matches_resource_description(
+            res, self._rules[j], None, [], {}, '') is None
+
+    def match_matrix(self, resources: List[dict],
+                     wrapped: List[Resource]) -> np.ndarray:
+        """[R, P] bool match mask, group-cached for simple-match rules."""
+        n = len(resources)
+        p = len(self.cps.programs)
+        match = np.zeros((n, p), bool)
+        if p == 0:
+            return match
+        simple = np.asarray(self._simple_match)
+        # group resources by (kind, apiVersion, namespace)
+        groups: Dict[Tuple[str, str, str], List[int]] = {}
+        for i, doc in enumerate(resources):
+            groups.setdefault(_group_key(doc), []).append(i)
+        for key, idxs in groups.items():
+            cached = self._match_cache.get(key)
+            if cached is None:
+                rep = wrapped[idxs[0]]
+                cached = np.array([
+                    self._match_one(j, rep) if simple[j] else False
+                    for j in range(p)])
+                self._match_cache[key] = cached
+            match[idxs, :] = cached
+        # non-simple rules: evaluate per resource
+        for j in np.nonzero(~simple)[0]:
+            for i in range(n):
+                match[i, j] = self._match_one(int(j), wrapped[i])
+        return match
+
+    # -- device evaluation --------------------------------------------------
+
+    def _device_statuses(self, resources: List[dict]):
+        if not self.cps.programs:
+            z = np.zeros((len(resources), 0), np.int8)
+            return z, z
+        n = len(resources)
+        # bucketed padding: trace once per power-of-two bucket; padded rows
+        # evaluate on zeroed (TAG_MISSING) slots and are sliced off
+        bucket = max(64, 1 << (n - 1).bit_length())
+        batch = encode_batch(resources, self.cps, padded_n=bucket)
+        from ..ops.eval import shard_batch
+        tensors = shard_batch(batch.tensors(), self.mesh)
+        status, detail = self._evaluator(tensors)
+        return np.asarray(status)[:n], np.asarray(detail)[:n]
+
+    def scan_statuses(self, resources: List[dict]):
+        """Raw (status, detail, match) matrices over all compiled programs
+        — the allocation-free fast path for throughput measurement and
+        report aggregation."""
+        wrapped = [Resource(r) for r in resources]
+        status, detail = self._device_statuses(resources)
+        match = self.match_matrix(resources, wrapped)
+        return status, detail, match
+
+    # -- full responses -----------------------------------------------------
 
     def scan(self, resources: List[dict]) -> List[List[EngineResponse]]:
-        """Return, per resource, the engine responses of all policies."""
+        """Return, per resource, the engine responses of all policies with
+        at least one applicable rule (host-identical)."""
         n = len(resources)
         if n == 0:
             return []
         wrapped = [Resource(r) for r in resources]
+        status, detail = self._device_statuses(resources)
+        match = self.match_matrix(resources, wrapped)
+        now = time.time()
 
-        status = self._device_statuses(resources)
-
-        # match mask [R, P]
-        match = np.zeros((n, len(self.cps.programs)), bool)
-        for j, prog in enumerate(self.cps.programs):
-            for i, res in enumerate(wrapped):
-                match[i, j] = self._matches(j, prog, res)
+        # which host policies could match each resource at all (group
+        # screen over their simple rules; non-simple rules force a run)
+        host_maybe = self._host_policy_maybe(resources, wrapped)
 
         out: List[List[EngineResponse]] = []
         for i, res_doc in enumerate(resources):
             responses: Dict[int, EngineResponse] = {}
-            needs_host: set = set(self._host_policy_idx)
-            for j, prog in enumerate(self.cps.programs):
-                if not match[i, j] or prog.policy_index in needs_host:
+            for j, prog in self.device_programs:
+                if not match[i, j]:
                     continue
-                st = int(status[i, j])
+                policy = self.policies[prog.policy_index]
+                if not policy.background:
+                    # background-disabled policies contribute an empty
+                    # response (engine.py:174 apply_background_checks)
+                    if prog.policy_index not in responses:
+                        responses[prog.policy_index] = \
+                            self._new_response(prog.policy_index, res_doc, now)
+                    continue
                 resp = responses.get(prog.policy_index)
                 if resp is None:
-                    resp = self._new_response(prog.policy_index, res_doc)
+                    resp = self._new_response(prog.policy_index, res_doc, now)
                     responses[prog.policy_index] = resp
-                if st == 0:
+                st = int(status[i, j])
+                if st == STATUS_PASS:
                     rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                      prog.pass_message, RuleStatus.PASS)
+                                      prog.pass_messages[int(detail[i, j])],
+                                      RuleStatus.PASS)
+                elif st == STATUS_SKIP_PRECOND:
+                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                      PRECONDITIONS_SKIP_MESSAGE,
+                                      RuleStatus.SKIP)
+                elif st == STATUS_VAR_ERR:
+                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                      prog.error_messages[int(detail[i, j])],
+                                      RuleStatus.ERROR)
                 else:
-                    # non-pass: materialize the exact message by re-walking
-                    # just this rule's pattern (compiled rules are
-                    # variable-free, so the walk is context-independent)
+                    # FAIL / anchor-SKIP / HOST: re-run this rule on the
+                    # host for the exact status + message
                     rr = self._materialize(prog, res_doc)
+                    if rr is None:
+                        continue
+                rr.timestamp = int(now)
                 resp.policy_response.rules.append(rr)
                 if rr.status in (RuleStatus.PASS, RuleStatus.FAIL):
                     resp.policy_response.rules_applied_count += 1
                 elif rr.status == RuleStatus.ERROR:
                     resp.policy_response.rules_error_count += 1
-            for p_idx in needs_host:
-                responses[p_idx] = self._host_run(p_idx, res_doc)
+            for p_idx in self._host_policy_idx:
+                if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
+                    responses[p_idx] = self._host_run(p_idx, res_doc)
+                else:
+                    responses[p_idx] = self._new_response(p_idx, res_doc, now)
             out.append([responses[k] for k in sorted(responses)])
         return out
 
-    def _materialize(self, prog: RuleProgram, resource: dict) -> RuleResponse:
+    def _host_policy_maybe(self, resources, wrapped):
+        """Per host policy: bool[R] 'any rule may match', or None when the
+        policy has non-simple rules (always run)."""
+        from ..autogen.autogen import compute_rules
+        maybe: Dict[int, Optional[np.ndarray]] = {}
+        group_of = [_group_key(doc) for doc in resources]
+        for p_idx in self._host_policy_idx:
+            policy = self.policies[p_idx]
+            rules = compute_rules(policy)
+            if not all(_rule_match_is_simple(r) for r in rules):
+                maybe[p_idx] = None
+                continue
+            cache: Dict[Tuple, bool] = {}
+            flags = np.zeros(len(resources), bool)
+            robj = [Rule(r) for r in rules]
+            for i, key in enumerate(group_of):
+                hit = cache.get(key)
+                if hit is None:
+                    res = wrapped[i]
+                    hit = self._policy_gate(policy, res) and any(
+                        matches_resource_description(
+                            res, r, None, [], {}, '') is None
+                        for r in robj)
+                    cache[key] = hit
+                flags[i] = hit
+            maybe[p_idx] = flags
+        return maybe
+
+    def _materialize(self, prog: RuleProgram,
+                     resource: dict) -> Optional[RuleResponse]:
         """Produce the exact host-engine rule response for one rule."""
         from ..engine.engine import Validator
         pctx = PolicyContext(self.policies[prog.policy_index],
@@ -139,19 +269,8 @@ class BatchScanner:
         rule = Rule(prog.rule_raw or {})
         return Validator(self.engine, pctx, rule).validate()
 
-    def _device_statuses(self, resources: List[dict]) -> np.ndarray:
-        if not self.cps.programs:
-            return np.zeros((len(resources), 0), np.int8)
-        n = len(resources)
-        # bucketed padding: compile once per power-of-two bucket; padded
-        # rows evaluate on zeroed (TAG_MISSING) slots and are sliced off
-        bucket = max(64, 1 << (n - 1).bit_length())
-        batch = encode_batch(resources, self.cps, padded_n=bucket)
-        from ..ops.eval import shard_batch
-        tensors = shard_batch(batch.tensors(), self.mesh)
-        return np.asarray(self._evaluator(tensors))[:n]
-
-    def _new_response(self, policy_index: int, resource: dict) -> EngineResponse:
+    def _new_response(self, policy_index: int, resource: dict,
+                      now: float) -> EngineResponse:
         policy = self.policies[policy_index]
         resp = EngineResponse(policy, patched_resource=resource)
         pr = resp.policy_response
@@ -165,6 +284,7 @@ class BatchScanner:
         pr.validation_failure_action = policy.validation_failure_action
         pr.validation_failure_action_overrides = \
             policy.validation_failure_action_overrides
+        pr.timestamp = int(now)
         return resp
 
     def _host_run(self, policy_index: int, resource: dict) -> EngineResponse:

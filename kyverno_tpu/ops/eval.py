@@ -1,255 +1,117 @@
-"""Batched rule evaluation on device.
+"""Batched rule evaluation on device (IR v2: tri-state status programs).
 
-``build_evaluator(cps)`` returns a jitted function mapping the encoded batch
-tensors to a status matrix ``[R, P]`` (0=pass, 1=fail, 2=skip) for the
-compiled programs. The program structure is baked in at trace time, so XLA
-sees straight-line fused elementwise ops over ``[R]`` / ``[R, E]`` tensors —
-the policy set is *compiled*, not interpreted.
+``build_evaluator(cps)`` returns a jitted function mapping the encoded
+batch tensors to ``(status [R, P], detail [R, P])`` int8 matrices for the
+compiled programs, where status is one of
 
-Sharding: the batch axis is data-parallel; ``shard_batch`` places tensors on
-a 1-D mesh so the same jitted function scales across chips via pjit/GSPMD.
+  0 PASS   1 FAIL   2 SKIP   3 HOST   4 SKIP_PRECOND
+
+``HOST`` marks (resource, rule) pairs the device could not decide exactly
+(Kleene UNKNOWN anywhere in the tree); the scanner re-runs just those on
+the host engine, so exactness is never lost.  ``detail`` carries the
+anyPattern index that passed (for the pass-message template).
+
+The program structure is baked in at trace time: XLA sees straight-line
+fused elementwise ops over ``[R]`` / ``[R, E]`` tensors — the policy set
+is *compiled*, not interpreted (reference's per-resource tree walk:
+pkg/engine/validate/validate.go).
+
+Boolean facts are tracked as Kleene pairs ``(t, f)`` (known-true,
+known-false); any value the encoder could not represent exactly simply
+never sets either bit and surfaces as HOST.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, List, Tuple
+import json as _json
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..compiler.encode import TAIL_LEN, Batch
-from ..compiler.ir import (MAX_ELEMS, STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT,
-                           TAG_INT, TAG_MISSING, TAG_NULL, TAG_STRING,
-                           BoolExpr, CompiledPolicySet, ElementBlock, Leaf,
-                           RuleProgram)
+from ..compiler.encode import _needs_cached
+from ..compiler.ir import (MAX_ELEMS, MAX_GATHER, STR_LEN, TAG_ARRAY,
+                           TAG_BOOL, TAG_FLOAT, TAG_INT, TAG_MAP, TAG_MISSING,
+                           TAG_NULL, TAG_STRING, TAIL_LEN, BoolExpr,
+                           CompiledPolicySet, CondCheck, Leaf, RuleProgram,
+                           Slot, StatusExpr)
+from ..compiler.ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
+                           STATUS_SKIP_PRECOND, STATUS_VAR_ERR)
+from ..engine import pattern as leaf_pattern
+from ..engine.operators import _sprint
+from ..utils.duration import parse_duration
+from ..utils.quantity import Quantity
 
-STATUS_PASS, STATUS_FAIL, STATUS_SKIP = 0, 1, 2
+_I64_MAX = (1 << 63) - 1
+# milli magnitudes beyond this may round differently under the host's
+# float64 comparisons → undecidable on device
+_FLOAT_SAFE_MILLI = (1 << 53) * 1000
 
-_CONVERTIBLE_TAGS = (TAG_STRING, TAG_INT, TAG_FLOAT, TAG_BOOL)
+
+def _const_bytes(s: str) -> bytes:
+    return s.encode('utf-8')
 
 
-def _str_const(s: str, length: int) -> np.ndarray:
-    b = s.encode('utf-8')[:length]
-    out = np.zeros(length, np.uint8)
-    out[:len(b)] = np.frombuffer(b, np.uint8)
+def _head_const(b: bytes) -> np.ndarray:
+    out = np.zeros(STR_LEN, np.uint8)
+    w = b[:STR_LEN]
+    out[:len(w)] = np.frombuffer(w, np.uint8)
     return out
 
 
-def _tail_const(s: str) -> np.ndarray:
-    b = s.encode('utf-8')[-TAIL_LEN:]
-    out = np.zeros(TAIL_LEN, np.uint8)
-    out[TAIL_LEN - len(b):] = np.frombuffer(b, np.uint8)
+class _K:
+    """Kleene pair of known-true / known-false boolean arrays."""
+
+    __slots__ = ('t', 'f')
+
+    def __init__(self, t, f):
+        self.t = t
+        self.f = f
+
+    @staticmethod
+    def known(v):
+        return _K(v, ~v)
+
+    @staticmethod
+    def const(shape, value: bool):
+        ones = jnp.ones(shape, bool)
+        return _K(ones, ~ones) if value else _K(~ones, ones)
+
+    @staticmethod
+    def false_const(shape):
+        return _K.const(shape, False)
+
+    def negate(self) -> '_K':
+        return _K(self.f, self.t)
+
+    def __and__(self, other: '_K') -> '_K':
+        return _K(self.t & other.t, self.f | other.f)
+
+    def __or__(self, other: '_K') -> '_K':
+        return _K(self.t | other.t, self.f & other.f)
+
+    def unknown(self):
+        return ~(self.t | self.f)
+
+
+def _k_all(parts: List[_K]) -> _K:
+    out = parts[0]
+    for p in parts[1:]:
+        out = out & p
     return out
 
 
-class _SlotRef:
-    """Names of the tensors for one slot inside the flat batch dict."""
-
-    def __init__(self, prefix: str):
-        self.prefix = prefix
-
-    def __getattr__(self, name):
-        return f'{self.prefix}_{name}'
+def _k_any(parts: List[_K]) -> _K:
+    out = parts[0]
+    for p in parts[1:]:
+        out = out | p
+    return out
 
 
-def build_evaluator(cps: CompiledPolicySet):
-    slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
-    array_prefix = {}
-    array_paths = []
-    for prog in cps.programs:
-        for block in prog.elements:
-            if block.array_path not in array_prefix:
-                array_prefix[block.array_path] = f'a{len(array_paths)}'
-                array_paths.append(block.array_path)
-
-    def leaf_eval(t: Dict[str, jnp.ndarray], leaf: Leaf) -> jnp.ndarray:
-        p = slot_prefix[leaf.slot]
-        tag = t[f'{p}_tag']
-        op = leaf.op
-
-        def is_tag(*tags):
-            r = tag == tags[0]
-            for x in tags[1:]:
-                r = r | (tag == x)
-            return r
-
-        convertible = is_tag(*_CONVERTIBLE_TAGS)
-        if op == 'true':
-            result = jnp.ones_like(tag, dtype=bool)
-        elif op == 'absent':
-            return tag == TAG_MISSING  # missing_ok does not apply
-        elif op == 'star':
-            return ~is_tag(TAG_MISSING, TAG_NULL)
-        elif op == 'any_str':
-            result = convertible
-        elif op == 'nonempty':
-            result = (is_tag(TAG_INT, TAG_FLOAT, TAG_BOOL) |
-                      ((tag == TAG_STRING) & (t[f'{p}_str_len'] > 0)))
-        elif op == 'convertible':
-            result = convertible
-        elif op == 'eq_bool':
-            result = (tag == TAG_BOOL) & (
-                (t[f'{p}_milli'] != 0) == bool(leaf.operand))
-        elif op == 'eq_null':
-            result = ((tag == TAG_NULL) |
-                      (is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT) &
-                       t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == 0)) |
-                      ((tag == TAG_STRING) & (t[f'{p}_str_len'] == 0)))
-        elif op == 'eq_int':
-            target = int(leaf.operand) * 1000
-            ok = t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == target)
-            result = ok & (is_tag(TAG_INT, TAG_FLOAT) |
-                           ((tag == TAG_STRING) & t[f'{p}_str_is_int']))
-        elif op == 'eq_float':
-            from fractions import Fraction
-            target = int(Fraction(str(leaf.operand)) * 1000)
-            ok = t[f'{p}_milli_ok'] & (t[f'{p}_milli'] == target)
-            result = ok & (is_tag(TAG_INT, TAG_FLOAT) |
-                           ((tag == TAG_STRING) & t[f'{p}_str_is_float']))
-        elif op == 'cmp_qty':
-            # compareDuration/Quantity/String are a plain OR chain in the
-            # reference, so quantity validity is just "parses as quantity"
-            # (milli_ok covers that for strings)
-            cmp, operand = leaf.operand
-            valid = t[f'{p}_milli_ok'] & is_tag(TAG_INT, TAG_FLOAT, TAG_NULL,
-                                                TAG_STRING)
-            result = valid & _cmp(t[f'{p}_milli'], operand, cmp)
-        elif op == 'cmp_dur':
-            cmp, operand = leaf.operand
-            valid = t[f'{p}_nanos_ok'] & is_tag(TAG_STRING, TAG_NULL)
-            result = valid & _cmp(t[f'{p}_nanos'], operand, cmp)
-        elif op == 'eq_str':
-            const = _str_const(leaf.operand, STR_LEN)
-            blen = len(leaf.operand.encode('utf-8'))
-            result = (convertible & (t[f'{p}_str_len'] == blen) &
-                      jnp.all(t[f'{p}_str_head'] == const, axis=-1))
-        elif op == 'prefix':
-            b = leaf.operand.encode('utf-8')
-            const = np.frombuffer(b, np.uint8)
-            head = t[f'{p}_str_head'][..., :len(b)]
-            result = (convertible & (t[f'{p}_str_len'] >= len(b)) &
-                      jnp.all(head == const, axis=-1))
-        elif op == 'suffix':
-            b = leaf.operand.encode('utf-8')
-            const = np.frombuffer(b, np.uint8)
-            tail = t[f'{p}_str_tail'][..., TAIL_LEN - len(b):]
-            result = (convertible & (t[f'{p}_str_len'] >= len(b)) &
-                      jnp.all(tail == const, axis=-1))
-        elif op == 'min_len':
-            result = convertible & (t[f'{p}_str_len'] >= int(leaf.operand))
-        else:
-            raise ValueError(f'unknown leaf op {op!r}')
-
-        if leaf.missing_ok:
-            return result | (tag == TAG_MISSING)
-        return result
-
-    def expr_eval(t, expr: BoolExpr) -> jnp.ndarray:
-        if expr.kind == 'leaf':
-            return leaf_eval(t, expr.leaf)
-        if expr.kind == 'and':
-            out = expr_eval(t, expr.children[0])
-            for c in expr.children[1:]:
-                out = out & expr_eval(t, c)
-            return out
-        if expr.kind == 'or':
-            out = expr_eval(t, expr.children[0])
-            for c in expr.children[1:]:
-                out = out | expr_eval(t, c)
-            return out
-        if expr.kind == 'not':
-            return ~expr_eval(t, expr.children[0])
-        raise ValueError(expr.kind)
-
-    def block_status(t, block: ElementBlock) -> jnp.ndarray:
-        """Tri-state per resource for one element block."""
-        ap = array_prefix[block.array_path]
-        arr_tag = t[f'{ap}_tag']
-        count = t[f'{ap}_count']
-        valid = jnp.arange(MAX_ELEMS)[None, :] < count[:, None]
-        cons = expr_eval(t, block.constraint)
-        if cons.ndim == 1:  # constraint referenced no element slot
-            cons = jnp.broadcast_to(cons[:, None], valid.shape)
-        if block.condition is not None:
-            cond = expr_eval(t, block.condition)
-            if cond.ndim == 1:
-                cond = jnp.broadcast_to(cond[:, None], valid.shape)
-        else:
-            cond = jnp.ones_like(valid)
-        if block.mode == 'exists':
-            # existence anchor: ≥1 element must satisfy; empty array fails,
-            # missing key passes (reference: anchor/handlers.go:228)
-            satisfied = jnp.any(valid & cons, axis=1)
-            missing = arr_tag == TAG_MISSING
-            wrong_type = (arr_tag != TAG_ARRAY) & ~missing
-            status = jnp.where(
-                missing, STATUS_PASS,
-                jnp.where(wrong_type | ~satisfied, STATUS_FAIL, STATUS_PASS))
-            return status.astype(jnp.int8)
-        fail_e = valid & cond & ~cons
-        skip_e = valid & ~cond
-        pass_e = valid & cond & cons
-        any_fail = jnp.any(fail_e, axis=1)
-        any_pass = jnp.any(pass_e, axis=1)
-        any_skip = jnp.any(skip_e, axis=1)
-        # array itself missing or not a list → structural failure
-        bad_array = arr_tag != TAG_ARRAY
-        status = jnp.where(
-            bad_array | any_fail, STATUS_FAIL,
-            jnp.where(~any_pass & any_skip, STATUS_SKIP, STATUS_PASS))
-        return status.astype(jnp.int8)
-
-    def program_status(t, prog: RuleProgram) -> jnp.ndarray:
-        n = t[next(iter(t))].shape[0]
-        units: List[jnp.ndarray] = []
-        if prog.scalar_condition is not None:
-            cond_ok = expr_eval(t, prog.scalar_condition)
-            units.append(jnp.where(cond_ok, STATUS_PASS,
-                                   STATUS_SKIP).astype(jnp.int8))
-        if prog.scalar is not None:
-            ok = expr_eval(t, prog.scalar)
-            units.append(jnp.where(ok, STATUS_PASS,
-                                   STATUS_FAIL).astype(jnp.int8))
-        for block in prog.elements:
-            units.append(block_status(t, block))
-        if not units:
-            return jnp.zeros(n, jnp.int8)
-        # first non-pass unit in order decides (mirrors the walk's
-        # first-error-wins semantics)
-        status = units[0]
-        for u in units[1:]:
-            status = jnp.where(status == STATUS_PASS, u, status)
-        return status
-
-    def evaluate(t: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-        cols = [program_status(t, prog) for prog in cps.programs]
-        if not cols:
-            n = t[next(iter(t))].shape[0] if t else 0
-            return jnp.zeros((n, 0), jnp.int8)
-        return jnp.stack(cols, axis=1)
-
-    jitted = jax.jit(evaluate)
-
-    def call(t: Dict[str, Any]) -> jnp.ndarray:
-        # i64 lanes are required: quantity milli-values span past 2^31
-        # (4Gi milli ≈ 4.3e12). Scope x64 to this call instead of flipping
-        # the process-global flag at import time; transfers of the int64
-        # inputs must happen inside the scope too (see shard_batch).
-        with enable_x64():
-            return jitted(t)
-
-    call.jitted = jitted
-    return call
-
-
-def enable_x64():
-    return jax.enable_x64()
-
-
-def _cmp(value, operand, cmp):
+def _cmp_arr(value, operand, cmp: str):
     if cmp == '>':
         return value > operand
     if cmp == '>=':
@@ -263,6 +125,1031 @@ def _cmp(value, operand, cmp):
     if cmp == '!=':
         return value != operand
     raise ValueError(cmp)
+
+
+def _frac_thresholds(cmp: str, target: Fraction) -> Tuple[str, int]:
+    """Rewrite ``milli cmp target`` (target rational ×1000) as an integer
+    comparison on the milli lane (exact for any rational threshold)."""
+    import math
+    if target.denominator == 1:
+        return cmp, int(target)
+    if cmp == '>':
+        return '>=', math.floor(target) + 1
+    if cmp == '>=':
+        return '>=', math.ceil(target)
+    if cmp == '<':
+        return '<=', math.ceil(target) - 1
+    if cmp == '<=':
+        return '<=', math.floor(target)
+    if cmp == '==':
+        return '==', None  # never equal — caller handles
+    if cmp == '!=':
+        return '!=', None  # always unequal
+    raise ValueError(cmp)
+
+
+class _View:
+    """Accessor for one lane bundle (slot or gather elements) in the flat
+    tensor dict, plus tag predicates shared by all ops."""
+
+    def __init__(self, t: Dict[str, Any], prefix: str, elem: int = None):
+        self._t = t
+        self._p = prefix
+        self._elem = elem  # gather element index (axis 1), or None
+
+    def lane(self, name: str):
+        arr = self._t[f'{self._p}_{name}']
+        if self._elem is not None:
+            arr = arr[:, self._elem]
+        return arr
+
+    def has(self, name: str) -> bool:
+        return f'{self._p}_{name}' in self._t
+
+    @property
+    def tag(self):
+        return self.lane('tag')
+
+    def is_tag(self, *tags):
+        tag = self.tag
+        r = tag == tags[0]
+        for x in tags[1:]:
+            r = r | (tag == x)
+        return r
+
+    @property
+    def convertible(self):
+        return self.is_tag(TAG_STRING, TAG_INT, TAG_FLOAT, TAG_BOOL)
+
+    @property
+    def numish(self):
+        return self.is_tag(TAG_INT, TAG_FLOAT)
+
+    @property
+    def nullish(self):
+        # missing keys validate as nil (anchor.py handle_element default:
+        # resource_map.get(key) → None)
+        return self.is_tag(TAG_NULL, TAG_MISSING)
+
+    @property
+    def arrayish(self):
+        return self.tag == TAG_ARRAY
+
+    @property
+    def milli(self):
+        return self.lane('milli')
+
+    @property
+    def milli_ok(self):
+        # missing == nil: _number_to_string(None) == '0' → 0 exactly
+        return self.lane('milli_ok') | (self.tag == TAG_MISSING)
+
+    @property
+    def nanos(self):
+        return self.lane('nanos')
+
+    @property
+    def nanos_ok(self):
+        return self.lane('nanos_ok') | (self.tag == TAG_MISSING)
+
+    @property
+    def str_len(self):
+        return self.lane('str_len')
+
+    @property
+    def is_zero_str(self):
+        """The literal string '0' (excluded from operator duration parse,
+        reference: pkg/engine/variables/operator/operator.go:80)."""
+        head0 = self.lane('str_head')[..., 0]
+        return (self.str_len == 1) & (head0 == ord('0'))
+
+    # duration usable under LEAF semantics (pattern.py _compare_duration:
+    # the plain string form parses, '0' included).  The encoder sets
+    # nanos_ok for int 0 ('0' parses) and nulls; floats never parse
+    # ('0.000000' has no unit).
+    @property
+    def dur_leaf(self):
+        return (((self.tag == TAG_STRING) & self.lane('str_is_dur')) |
+                ((self.tag == TAG_INT) & self.lane('nanos_ok')) |
+                self.nullish)
+
+    # string equality / prefix / suffix against a constant ---------------
+
+    def eq_const(self, s: str) -> _K:
+        b = _const_bytes(s)
+        conv = self.convertible
+        if len(b) <= STR_LEN:
+            hit = (conv & (self.str_len == len(b)) &
+                   jnp.all(self.lane('str_head') == _head_const(b), axis=-1))
+            return _K(hit, ~hit & ~self.arrayish)
+        # constant longer than the window: tail+head agree → undecidable
+        maybe = conv & (self.str_len == len(b))
+        f = ~maybe & ~self.arrayish
+        return _K(jnp.zeros_like(maybe), f)
+
+    def prefix_const(self, s: str) -> _K:
+        b = _const_bytes(s)
+        conv = self.convertible
+        head = self.lane('str_head')[..., :len(b)]
+        const = np.frombuffer(b, np.uint8)
+        hit = conv & (self.str_len >= len(b)) & jnp.all(head == const, axis=-1)
+        return _K(hit, ~hit & ~self.arrayish)
+
+    def suffix_const(self, s: str) -> _K:
+        b = _const_bytes(s)
+        conv = self.convertible
+        tail = self.lane('str_tail')[..., TAIL_LEN - len(b):]
+        const = np.frombuffer(b, np.uint8)
+        hit = conv & (self.str_len >= len(b)) & jnp.all(tail == const, axis=-1)
+        return _K(hit, ~hit & ~self.arrayish)
+
+    def wildcard_const(self, pattern: str) -> _K:
+        """Glob ``pattern`` (utils/wildcard.py semantics) vs the value's
+        string form; undecidable when the value exceeds the byte window or
+        '?' meets non-ASCII bytes (rune vs byte width)."""
+        conv = self.convertible
+        head = self.lane('str_head')
+        vlen = jnp.minimum(self.str_len, STR_LEN)
+        pb = _const_bytes(pattern)
+        # dp[j]: pattern consumed so far matches value[:j]
+        shape = head.shape[:-1]
+        dp = jnp.zeros(shape + (STR_LEN + 1,), bool)
+        dp = dp.at[..., 0].set(True)
+        pos_valid = jnp.arange(STR_LEN) < vlen[..., None]
+        for ch in pb:
+            if ch == ord('*'):
+                dp = jnp.cumsum(dp.astype(jnp.int32), axis=-1) > 0
+            elif ch == ord('?'):
+                step = dp[..., :-1] & pos_valid
+                dp = jnp.concatenate(
+                    [jnp.zeros(shape + (1,), bool), step], axis=-1)
+            else:
+                step = dp[..., :-1] & (head == ch) & pos_valid
+                dp = jnp.concatenate(
+                    [jnp.zeros(shape + (1,), bool), step], axis=-1)
+        matched = jnp.take_along_axis(dp, vlen[..., None], axis=-1)[..., 0]
+        in_window = self.str_len <= STR_LEN
+        if b'?' in bytes(pb):
+            ascii_ok = jnp.all((head < 0x80) | ~pos_valid, axis=-1)
+        else:
+            ascii_ok = jnp.ones(shape, bool)
+        decid = in_window & ascii_ok
+        t = conv & decid & matched
+        f = (~self.arrayish) & (~conv | (decid & ~matched))
+        return _K(t, f)
+
+    def match_const_pattern(self, s: str) -> _K:
+        """wildcard.match(const_pattern, value_string)."""
+        if '*' not in s and '?' not in s:
+            return self.eq_const(s)
+        if s == '*':
+            return _K(self.convertible, ~self.convertible & ~self.arrayish)
+        return self.wildcard_const(s)
+
+
+# ---------------------------------------------------------------------------
+# leaf (pattern) ops over a view — semantics: kyverno_tpu/engine/pattern.py
+# (reference: pkg/engine/pattern/pattern.go)
+
+def leaf_op_tf(v: _View, op: str, operand: Any) -> _K:
+    arr = v.arrayish
+
+    if op == 'true':
+        return _K.const(v.tag.shape, True)
+    if op == 'absent':
+        return _K.known(v.tag == TAG_MISSING)
+    if op == 'present':
+        return _K.known(v.tag != TAG_MISSING)
+    if op == 'star':
+        # anchor default-key "*": passes on any non-nil value
+        return _K.known(~v.nullish)
+    if op == 'is_map':
+        return _K.known(v.tag == TAG_MAP)
+    if op == 'is_array':
+        return _K.known(v.tag == TAG_ARRAY)
+    if op == 'any_str':
+        return _K(v.convertible, ~v.convertible & ~arr)
+    if op == 'nonempty':
+        t = (v.is_tag(TAG_INT, TAG_FLOAT, TAG_BOOL) |
+             ((v.tag == TAG_STRING) & (v.str_len > 0)))
+        return _K(t, ~t & ~arr)
+    if op == 'convertible':
+        return _K(v.convertible, ~v.convertible & ~arr)
+    if op == 'eq_bool':
+        t = (v.tag == TAG_BOOL) & ((v.milli != 0) == bool(operand))
+        return _K(t, ~t & ~arr)
+    if op == 'eq_null':
+        t = (v.nullish |
+             (v.is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT) & v.milli_ok &
+              (v.milli == 0)) |
+             ((v.tag == TAG_STRING) & (v.str_len == 0)))
+        return _K(t, ~t & ~arr)
+    if op in ('eq_int', 'eq_float'):
+        target = (int(operand) * 1000 if op == 'eq_int'
+                  else int(Fraction(str(operand)) * 1000))
+        flag = 'str_is_int' if op == 'eq_int' else 'str_is_float'
+        cand = v.numish | ((v.tag == TAG_STRING) & v.lane(flag))
+        mok = v.lane('milli_ok')
+        t = cand & mok & (v.milli == target)
+        u = cand & ~mok
+        return _K(t, ~t & ~u & ~arr)
+    if op == 'cmp_qty':
+        cmp, target = operand
+        cand = (v.numish | v.nullish |
+                ((v.tag == TAG_STRING) & v.lane('str_is_qty')))
+        mok = v.milli_ok
+        t = cand & mok & _cmp_arr(v.milli, target, cmp)
+        u = cand & ~mok
+        return _K(t, ~t & ~u & ~arr)
+    if op == 'cmp_dur':
+        cmp, target = operand
+        cand = v.dur_leaf
+        t = cand & v.nanos_ok & _cmp_arr(v.nanos, target, cmp)
+        # parsed-but-overflowed durations are undecidable
+        u = (v.tag == TAG_STRING) & v.lane('str_is_dur') & \
+            ~v.lane('nanos_ok')
+        return _K(t, ~t & ~u & ~arr)
+    if op == 'eq_str':
+        return v.eq_const(operand)
+    if op == 'prefix':
+        return v.prefix_const(operand)
+    if op == 'suffix':
+        return v.suffix_const(operand)
+    if op == 'min_len':
+        t = v.convertible & (v.str_len >= int(operand))
+        return _K(t, ~t & ~arr)
+    if op == 'wildcard':
+        return v.wildcard_const(operand)
+    raise ValueError(f'unknown leaf op {op!r}')
+
+
+# ---------------------------------------------------------------------------
+# string-term evaluation for condition values that are range / pattern
+# strings (leaf_pattern.validate semantics over a lane view)
+
+def string_term_tf(v: _View, term: str) -> _K:
+    op = leaf_pattern.get_operator_from_string_pattern(term)
+    if op == leaf_pattern.OP_IN_RANGE:
+        m = leaf_pattern.IN_RANGE_RE.match(term)
+        return (string_term_tf(v, f'>= {m.group(1)}') &
+                string_term_tf(v, f'<= {m.group(2)}'))
+    if op == leaf_pattern.OP_NOT_IN_RANGE:
+        m = leaf_pattern.NOT_IN_RANGE_RE.match(term)
+        return (string_term_tf(v, f'< {m.group(1)}') |
+                string_term_tf(v, f'> {m.group(2)}'))
+    operand = term[len(op):].strip(' ') if op else term
+    cmp = {leaf_pattern.OP_MORE: '>', leaf_pattern.OP_MORE_EQUAL: '>=',
+           leaf_pattern.OP_LESS: '<', leaf_pattern.OP_LESS_EQUAL: '<=',
+           leaf_pattern.OP_EQUAL: '==',
+           leaf_pattern.OP_NOT_EQUAL: '!='}[op or leaf_pattern.OP_EQUAL]
+    alts: List[_K] = []
+    try:
+        nanos = parse_duration(operand)
+        alts.append(leaf_op_tf(v, 'cmp_dur', (cmp, nanos)))
+    except (ValueError, TypeError):
+        pass
+    try:
+        q = Quantity.parse(operand)
+        m = q.value * 1000
+        if m.denominator == 1 and abs(m.numerator) <= _I64_MAX:
+            alts.append(leaf_op_tf(v, 'cmp_qty', (cmp, int(m))))
+        else:
+            cand = (v.numish | v.nullish |
+                    ((v.tag == TAG_STRING) & v.lane('str_is_qty')))
+            decided = cand & v.milli_ok
+            if cmp in ('==', '!='):
+                # a milli-exact value can never equal a sub-milli constant
+                hit = decided if cmp == '!=' else jnp.zeros_like(decided)
+                alts.append(_K(hit, (decided & ~hit) | (~cand & ~v.arrayish)))
+            else:
+                c2, thr = _frac_thresholds(cmp, m)
+                alts.append(leaf_op_tf(v, 'cmp_qty', (c2, thr)))
+    except ValueError:
+        pass
+    if cmp in ('==', '!='):
+        s = v.match_const_pattern(operand)
+        if cmp == '!=':
+            conv = _K(v.convertible, ~v.convertible & ~v.arrayish)
+            s = conv & s.negate()
+        alts.append(s)
+    if not alts:
+        return _K.false_const(v.tag.shape)
+    return _k_any(alts)
+
+
+def string_pattern_tf(v: _View, pattern: str) -> _K:
+    """leaf_pattern._validate_string_patterns over a view."""
+    parts = [v.eq_const(pattern)]  # value == pattern literal short-circuit
+    for condition in pattern.split('|'):
+        ands = [string_term_tf(v, t.strip(' '))
+                for t in condition.strip(' ').split('&')]
+        parts.append(_k_all(ands))
+    return _k_any(parts)
+
+
+# ---------------------------------------------------------------------------
+# condition (deny / precondition) checks over gathers — semantics:
+# kyverno_tpu/engine/operators.py (reference: pkg/engine/variables/operator)
+
+def _scalar_eq_const(sv: _View, value: Any) -> _K:
+    """operators._equal(key=<scalar gather>, value=<const>)."""
+    shape = sv.tag.shape
+    if isinstance(value, bool):
+        t = (sv.tag == TAG_BOOL) & ((sv.milli != 0) == value)
+        return _K(t, ~t)
+    if isinstance(value, (int, float)):
+        # key bool→False; key num → exact numeric eq; key str → duration
+        # pair only (operators.py:141-162,180-192)
+        target = Fraction(str(value)) * 1000
+        mok = sv.lane('milli_ok')
+        if target.denominator == 1 and abs(target) <= _I64_MAX:
+            num_t = sv.numish & mok & (sv.milli == int(target))
+        else:
+            num_t = jnp.zeros(shape, bool)
+        num_u = sv.numish & ~mok
+        dur_key = ((sv.tag == TAG_STRING) & sv.lane('str_is_dur') &
+                   ~sv.is_zero_str)
+        vd = Fraction(str(value)) * (10 ** 9)
+        if vd.denominator == 1:
+            dur_t = dur_key & sv.lane('nanos_ok') & (sv.nanos == int(vd))
+        else:
+            dur_t = jnp.zeros(shape, bool)
+        dur_u = dur_key & ~sv.lane('nanos_ok')
+        t = num_t | dur_t
+        u = num_u | dur_u
+        return _K(t, ~t & ~u)
+    if isinstance(value, str):
+        return _scalar_eq_str_const(sv, value)
+    if value is None:
+        return _K.false_const(shape)  # _equal(key, None) is always False
+    if isinstance(value, list):
+        return _K.false_const(shape)  # scalar key vs list value → False
+    return _K.false_const(shape)
+
+
+def _scalar_eq_str_const(sv: _View, value: str) -> _K:
+    shape = sv.tag.shape
+    # key num: float(value) == float(key)  (operators.py:157-177)
+    try:
+        fv = float(value)
+        target = Fraction(str(fv)) * 1000
+        mok = (sv.lane('milli_ok') &
+               (jnp.abs(sv.milli) <= _FLOAT_SAFE_MILLI))
+        if target.denominator == 1 and abs(target) <= _I64_MAX:
+            num_t = sv.numish & mok & (sv.milli == int(target))
+        else:
+            num_t = jnp.zeros(shape, bool)
+        num_u = sv.numish & ~mok
+    except ValueError:
+        num_t = jnp.zeros(shape, bool)
+        num_u = jnp.zeros(shape, bool)
+    # key str (operators.py:180 _equal_string): duration pair first
+    is_str = sv.tag == TAG_STRING
+    dur_key = is_str & sv.lane('str_is_dur') & ~sv.is_zero_str
+    try:
+        vnanos: Optional[int] = (parse_duration(value)
+                                 if value != '0' else None)
+    except (ValueError, TypeError):
+        vnanos = None
+    if vnanos is not None:
+        dur_t = dur_key & sv.lane('nanos_ok') & (sv.nanos == vnanos)
+        dur_decided = dur_key
+        dur_u = dur_key & ~sv.lane('nanos_ok')
+    else:
+        # value not a duration and not numeric → pair=None → quantity next
+        dur_t = jnp.zeros(shape, bool)
+        dur_decided = jnp.zeros(shape, bool)
+        dur_u = jnp.zeros(shape, bool)
+    # quantity: key parses as quantity → decided by quantity compare alone
+    qty_key = is_str & sv.lane('str_is_qty') & ~dur_decided
+    try:
+        vq = Quantity.parse(value)
+        vm = vq.value * 1000
+        if vm.denominator == 1 and abs(vm.numerator) <= _I64_MAX:
+            qty_t = qty_key & sv.lane('milli_ok') & (sv.milli == int(vm))
+        else:
+            qty_t = jnp.zeros(shape, bool)
+        qty_u = qty_key & ~sv.lane('milli_ok')
+    except ValueError:
+        # value not a quantity → quantity-keyed compare is False
+        qty_t = jnp.zeros(shape, bool)
+        qty_u = jnp.zeros(shape, bool)
+    # wildcard string match for plain-string keys
+    wild_key = is_str & ~dur_decided & ~qty_key
+    wk = sv.match_const_pattern(value)
+    wild_t = wild_key & wk.t
+    wild_u = wild_key & wk.unknown()
+    t = num_t | dur_t | qty_t | wild_t
+    u = num_u | dur_u | qty_u | wild_u
+    return _K(t, ~t & ~u)
+
+
+def _list_eq_const(ev: _View, count, overflow, values: Tuple[Any, ...]) -> _K:
+    """list key == list const (Python ``==`` semantics, elementwise)."""
+    shape = count.shape
+    if len(values) > MAX_GATHER:
+        # visible lists are shorter → known unequal; overflowed lists have
+        # an unknown true length → undecidable
+        return _K(jnp.zeros(shape, bool), ~overflow)
+    n = len(values)
+    mismatch = (count != n) | overflow
+    t_all = jnp.ones(shape, bool)
+    f_any = jnp.zeros(shape, bool)
+    u_any = jnp.zeros(shape, bool)
+    for i, cv in enumerate(values):
+        el = _View(ev._t, ev._p, i)
+        if cv is None:
+            ek = _K.known(el.tag == TAG_NULL)
+        elif isinstance(cv, (bool, int, float)):
+            # Python numeric equality spans bool/int/float: True == 1 == 1.0
+            target = Fraction(str(cv if not isinstance(cv, bool)
+                                  else (1 if cv else 0))) * 1000
+            numish = el.is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT)
+            mok = el.lane('milli_ok')
+            if target.denominator == 1 and abs(target) <= _I64_MAX:
+                et = numish & mok & (el.milli == int(target))
+            else:
+                et = jnp.zeros(shape, bool)
+            ek = _K(et, ~et & ~(numish & ~mok))
+        elif isinstance(cv, str):
+            is_str = el.tag == TAG_STRING
+            e = el.eq_const(cv)
+            ek = _K(is_str & e.t, ~is_str | (is_str & e.f))
+        else:  # nested list consts are rejected at compile time
+            ek = _K(jnp.zeros(shape, bool), jnp.zeros(shape, bool))
+        t_all = t_all & ek.t
+        f_any = f_any | ek.f
+        u_any = u_any | ek.unknown()
+    t = ~mismatch & t_all
+    f = mismatch | f_any
+    return _K(t, f & ~t)
+
+
+def _both_dir_member(view: _View, values: Tuple[Any, ...]) -> _K:
+    """∃ const v: wildcard.match(sprint(v), k) or wildcard.match(k,
+    sprint(v)) — the list-value membership of the In family
+    (operators.py:228,327-330)."""
+    hw = view.lane('has_wild') if view.has('has_wild') else None
+    parts: List[_K] = []
+    for cv in values:
+        vs = cv if isinstance(cv, str) else _sprint(cv)
+        m1 = view.match_const_pattern(vs)  # match(vs_as_pattern, key)
+        if hw is None:
+            parts.append(m1)
+            continue
+        # match(key_as_pattern, vs): for wildcard-free keys this is plain
+        # equality; wildcard keys are undecidable unless m1 already hit
+        eqc = view.eq_const(vs) if ('*' in vs or '?' in vs) else m1
+        parts.append(_K(m1.t | (eqc.t & ~hw), m1.f & eqc.f & ~hw))
+    return _k_any(parts)
+
+
+def _arr_member(view: _View, value: str) -> _K:
+    """k ∈ (json-list(value) or [value]) — plain string-form equality
+    (operators.py:339-345)."""
+    arr = _try_json_str_list(value)
+    if arr is None:
+        arr = [value]
+    return _k_any([view.eq_const(x) for x in arr])
+
+
+def _scalar_str_member(view: _View, value: str) -> _K:
+    """_key_in_array(k, value_str, allow_range=True) (operators.py:222):
+    wildcard match, else range validation, else set membership."""
+    m = view.match_const_pattern(value)
+    if leaf_pattern.get_operator_from_string_pattern(value) == \
+            leaf_pattern.OP_IN_RANGE:
+        return m | string_pattern_tf(view, value)
+    return m | _arr_member(view, value)
+
+
+def _try_json_str_list(value: str) -> Optional[List[str]]:
+    try:
+        arr = _json.loads(value)
+    except ValueError:
+        return None
+    if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
+        return arr
+    return None
+
+
+def _quantify(quant: str, em: _K, valid, overflow):
+    """Reduce elementwise Kleene membership over a list key.  Returns
+    (known-true, known-false) for the quantified statement."""
+    if quant == 'any':          # ∃ member
+        lt = jnp.any(valid & em.t, axis=-1)
+        lf = jnp.all(~valid | em.f, axis=-1) & ~overflow
+    elif quant == 'all':        # ∀ member (vacuously true when empty)
+        lt = jnp.all(~valid | em.t, axis=-1) & ~overflow
+        lf = jnp.any(valid & em.f, axis=-1)
+    elif quant == 'any_not':    # ∃ non-member
+        lt = jnp.any(valid & em.f, axis=-1)
+        lf = jnp.all(~valid | em.t, axis=-1) & ~overflow
+    elif quant == 'all_not':    # ∀ non-member
+        lt = jnp.all(~valid | em.f, axis=-1) & ~overflow
+        lf = jnp.any(valid & em.t, axis=-1)
+    else:
+        raise ValueError(quant)
+    return lt, lf
+
+
+def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
+    """AnyIn / AllIn and their negations (operators.py:299-395).  The
+    deprecated In/NotIn are host-only (rejected at compile time)."""
+    op = check.op
+    kind = t[f'{prefix}_kind']
+    count = t[f'{prefix}_count']
+    overflow = t[f'{prefix}_overflow']
+    shape = kind.shape
+    negate = op in ('anynotin', 'allnotin')
+
+    if not check.list_value and not isinstance(check.values[0], str):
+        # invalid value type: every host path returns False
+        return _K(jnp.zeros(shape, bool), jnp.ones(shape, bool))
+
+    sv = _View(t, prefix, 0)
+    ev = _View(t, prefix)
+
+    # ---- scalar key (str or num; bool/map/null → False) ----
+    scalar = kind == 1
+    scalar_ok = sv.is_tag(TAG_STRING, TAG_INT, TAG_FLOAT)
+    if check.list_value:
+        member = _both_dir_member(sv, check.values)
+    else:
+        member = _scalar_str_member(sv, check.values[0])
+    if negate:
+        member = member.negate()
+    scal_t = scalar & scalar_ok & member.t
+    scal_f = scalar & (~scalar_ok | member.f)
+
+    # ---- list key: per-element membership, then quantify ----
+    elem_valid = jnp.arange(MAX_GATHER)[None, :] < count[:, None]
+    shortcut = None
+    if check.list_value:
+        em = _both_dir_member(ev, check.values)
+        quant = {'anyin': 'any', 'allin': 'all',
+                 'anynotin': 'any_not', 'allnotin': 'any_not'}[op]
+    else:
+        value = check.values[0]
+        is_range = leaf_pattern.get_operator_from_string_pattern(value) == \
+            leaf_pattern.OP_IN_RANGE
+        if is_range:
+            # single-element lists equal to the literal range string hit
+            # the keys[0]==value shortcut before range validation
+            # (operators.py:332-338,383-387)
+            eq0 = _View(t, prefix, 0).eq_const(value)
+            shortcut = (count == 1) & eq0.t
+            if op == 'anynotin':
+                em = string_pattern_tf(ev, value.replace('-', '!-', 1))
+                quant = 'any'
+            elif op == 'allnotin':
+                em = string_pattern_tf(ev, value)
+                quant = 'all_not'
+            else:
+                em = string_pattern_tf(ev, value)
+                quant = {'anyin': 'any', 'allin': 'all'}[op]
+        else:
+            em = _arr_member(ev, value)
+            quant = {'anyin': 'any', 'allin': 'all',
+                     'anynotin': 'any_not', 'allnotin': 'any_not'}[op]
+    lt, lf = _quantify(quant, em, elem_valid, overflow)
+    if shortcut is not None:
+        if negate:
+            lt, lf = lt & ~shortcut, lf | shortcut
+        else:
+            lt, lf = lt | shortcut, lf & ~shortcut
+    lst = kind == 2
+    list_t = lst & lt
+    list_f = lst & lf
+
+    null_f = kind == 0
+    t_out = scal_t | list_t
+    f_out = (scal_f | list_f | null_f) & ~t_out
+    return _K(t_out, f_out)
+
+
+def _numeric_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
+    """GreaterThan / LessThan family (operators.py:413 _numeric)."""
+    op = check.op
+    kind = t[f'{prefix}_kind']
+    shape = kind.shape
+    sv = _View(t, prefix, 0)
+    value = check.values[0]
+    cmpmap = {'greaterthan': '>', 'greaterthanorequals': '>=',
+              'lessthan': '<', 'lessthanorequals': '<='}
+    cmp = cmpmap[op]
+    zeros = jnp.zeros(shape, bool)
+    scalar = kind == 1
+    mok = sv.lane('milli_ok') & (jnp.abs(sv.milli) <= _FLOAT_SAFE_MILLI)
+
+    # key num -------------------------------------------------------------
+    num_key = sv.numish
+    if isinstance(value, bool):
+        num_t, num_u = zeros, zeros
+    elif isinstance(value, (int, float)):
+        c2, thr = _frac_thresholds(cmp, Fraction(str(value)) * 1000)
+        num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
+        num_u = num_key & ~mok
+    elif isinstance(value, str):
+        vd = _op_duration(value)
+        if vd is not None:
+            # duration pair with numeric key: key*1e9 vs vd
+            c2, thr = _frac_thresholds(cmp, Fraction(vd, 1000000))
+            num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
+            num_u = num_key & ~mok
+        else:
+            try:
+                fv = float(value)
+                c2, thr = _frac_thresholds(cmp, Fraction(str(fv)) * 1000)
+                num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
+                num_u = num_key & ~mok
+            except ValueError:
+                num_t, num_u = zeros, zeros
+    else:
+        num_t, num_u = zeros, zeros
+
+    # key str -------------------------------------------------------------
+    is_str = sv.tag == TAG_STRING
+    dur_key = is_str & sv.lane('str_is_dur') & ~sv.is_zero_str
+    vd = None
+    if isinstance(value, str):
+        vd = _op_duration(value)
+        if vd is None and _is_op_num(value):
+            vd = None  # strings are never coerced on the value side here
+    elif isinstance(value, (int, float)) and not isinstance(value, bool):
+        vd = int(value * (10 ** 9))
+    if vd is not None:
+        dur_t = dur_key & sv.lane('nanos_ok') & _cmp_arr(sv.nanos, vd, cmp)
+        dur_u = dur_key & ~sv.lane('nanos_ok')
+        dur_decided = dur_key
+    else:
+        dur_t, dur_u = zeros, zeros
+        dur_decided = zeros
+    qty_key = is_str & sv.lane('str_is_qty') & ~dur_decided
+    vq = None
+    if isinstance(value, str):
+        try:
+            vq = Quantity.parse(value)
+        except ValueError:
+            vq = None
+    if vq is not None:
+        c2, thr = _frac_thresholds(cmp, vq.value * 1000)
+        qty_t = qty_key & sv.lane('milli_ok') & _cmp_arr(sv.milli, thr, c2)
+        qty_u = qty_key & ~sv.lane('milli_ok')
+        qty_decided = qty_key
+    else:
+        qty_t, qty_u = zeros, zeros
+        qty_decided = zeros
+    # float(key) fallback, then semver, then False
+    float_key = (is_str & sv.lane('str_is_float') & ~dur_decided &
+                 ~qty_decided)
+    if isinstance(value, bool):
+        f_t, f_u = zeros, zeros
+    elif isinstance(value, (int, float)):
+        c2, thr = _frac_thresholds(cmp, Fraction(str(value)) * 1000)
+        f_t = float_key & mok & _cmp_arr(sv.milli, thr, c2)
+        f_u = float_key & ~mok
+    elif isinstance(value, str):
+        fv = None
+        if _op_duration(value) is None:
+            try:
+                fv = float(value)
+            except ValueError:
+                fv = None
+        if fv is not None:
+            c2, thr = _frac_thresholds(cmp, Fraction(str(fv)) * 1000)
+            f_t = float_key & mok & _cmp_arr(sv.milli, thr, c2)
+            f_u = float_key & ~mok
+        else:
+            f_t, f_u = zeros, zeros
+    else:
+        f_t, f_u = zeros, zeros
+    # semver stage: undecidable on device when the const side is semver
+    semver_const = isinstance(value, str) and _is_semverish(value)
+    rest = is_str & ~dur_decided & ~qty_decided & ~float_key
+    semver_u = rest if semver_const else zeros
+
+    t_true = scalar & (num_t | dur_t | qty_t | f_t)
+    u = scalar & (num_u | dur_u | qty_u | f_u | semver_u)
+    return _K(t_true, ~t_true & ~u)
+
+
+def _op_duration(v: str) -> Optional[int]:
+    """operators._try_duration: duration strings except literal '0'."""
+    if isinstance(v, str) and v != '0':
+        try:
+            return parse_duration(v)
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def _is_op_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _is_semverish(v: str) -> bool:
+    from ..engine.operators import _try_semver
+    return _try_semver(v) is not None
+
+
+def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
+    op = check.op
+    kind = t[f'{prefix}_kind']
+    overflow = t[f'{prefix}_overflow']
+    shape = kind.shape
+    if op in ('equal', 'equals', 'notequal', 'notequals'):
+        sv = _View(t, prefix, 0)
+        scalar = kind == 1
+        if check.list_value:
+            eq_scal = _K.false_const(shape)  # scalar key vs list → False
+        else:
+            eq_scal = _scalar_eq_const(sv, check.values[0])
+        count = t[f'{prefix}_count']
+        if check.list_value:
+            eq_list = _list_eq_const(_View(t, prefix), count, overflow,
+                                     check.values)
+        else:
+            eq_list = _K.false_const(shape)  # list key vs scalar → False
+        nullk = kind == 0
+        eq_t = (scalar & eq_scal.t) | ((kind == 2) & eq_list.t)
+        eq_u = (scalar & eq_scal.unknown()) | ((kind == 2) & eq_list.unknown())
+        res = _K(eq_t, ~eq_t & ~eq_u)
+        if op in ('notequal', 'notequals'):
+            res = res.negate()
+        # raised queries (overflow on kind 0) and unresolvable paths
+        # (notfound → STATUS_VAR_ERR preempts at the precond/deny node)
+        # are undecidable at the condition level
+        raised = ((kind == 0) & overflow) | t[f'{prefix}_notfound']
+        return _K(res.t & ~raised, res.f & ~raised)
+    raised = ((kind == 0) & overflow) | t[f'{prefix}_notfound']
+    if op in ('in', 'anyin', 'allin', 'notin', 'anynotin', 'allnotin'):
+        res = _in_family_tf(t, prefix, check)
+        return _K(res.t & ~raised, res.f & ~raised)
+    if op in ('greaterthan', 'greaterthanorequals', 'lessthan',
+              'lessthanorequals'):
+        res = _numeric_tf(t, prefix, check)
+        return _K(res.t & ~raised, res.f & ~raised)
+    raise ValueError(f'condition op {op!r} not supported on device')
+
+
+# ---------------------------------------------------------------------------
+# evaluator assembly
+
+def build_evaluator(cps: CompiledPolicySet):
+    slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
+    gather_prefix = {g: f'g{k}' for k, g in enumerate(cps.gathers)}
+    _, _, array_paths = _needs_cached(cps)
+    array_prefix = {path: f'a{j}' for j, path in enumerate(array_paths)}
+
+    def broadcast(arr, depth: int):
+        """Append trailing element axes so arr has depth element dims."""
+        while arr.ndim < depth + 1:
+            arr = arr[..., None]
+        tgt = (arr.shape[0],) + (MAX_ELEMS,) * depth
+        return jnp.broadcast_to(arr, tgt)
+
+    leaf_cache: Dict[Tuple[Leaf, int], _K] = {}
+    cond_cache: Dict[CondCheck, _K] = {}
+
+    def eval_leaf(t, leaf: Leaf, depth: int) -> _K:
+        key = (leaf, depth)
+        if key in leaf_cache:
+            return leaf_cache[key]
+        if leaf.op == 'true':
+            n = t[next(iter(t))].shape[0]
+            shape = (n,) + (MAX_ELEMS,) * depth
+            out = _K.const(shape, True)
+        else:
+            view = _View(t, slot_prefix[leaf.slot])
+            out = leaf_op_tf(view, leaf.op, leaf.operand)
+            sd = leaf.slot.depth
+            if sd < depth:
+                out = _K(broadcast(out.t, depth), broadcast(out.f, depth))
+            elif sd > depth:
+                # reduce ALL over valid elements (trackfail guards): true
+                # iff every element satisfies; overflow blocks known-true
+                tt, ff = out.t, out.f
+                path = leaf.slot.path
+                for lvl in range(sd, depth, -1):
+                    prefix_path = _nth_star_prefix(path, lvl)
+                    ap = array_prefix.get(prefix_path)
+                    if ap is None:
+                        # container not tracked: cannot reduce exactly
+                        shape = tt.shape[:-1]
+                        tt = jnp.zeros(shape, bool)
+                        ff = jnp.zeros(shape, bool)
+                        continue
+                    count = t[f'{ap}_count']
+                    ovf = t[f'{ap}_overflow']
+                    valid = jnp.arange(MAX_ELEMS) < count[..., None]
+                    tt = jnp.all(tt | ~valid, axis=-1) & ~ovf
+                    ff = jnp.any(ff & valid, axis=-1)
+                out = _K(tt, ff)
+        leaf_cache[key] = out
+        return out
+
+    def _nth_star_prefix(path: Tuple[str, ...], lvl: int) -> Tuple[str, ...]:
+        seen = 0
+        for i, p in enumerate(path):
+            if p == '*':
+                seen += 1
+                if seen == lvl:
+                    return path[:i]
+        raise AssertionError('bad star level')
+
+    def eval_expr(t, expr: BoolExpr, depth: int) -> _K:
+        if expr.kind == 'leaf':
+            return eval_leaf(t, expr.leaf, depth)
+        if expr.kind == 'cond':
+            check = expr.cond
+            if check in cond_cache:
+                out = cond_cache[check]
+            else:
+                out = cond_tf(t, gather_prefix[check.gather], check)
+                cond_cache[check] = out
+            if depth > 0:
+                out = _K(broadcast(out.t, depth), broadcast(out.f, depth))
+            return out
+        parts = [eval_expr(t, c, depth) for c in expr.children]
+        if expr.kind == 'and':
+            return _k_all(parts)
+        if expr.kind == 'or':
+            return _k_any(parts)
+        if expr.kind == 'not':
+            return parts[0].negate()
+        raise ValueError(expr.kind)
+
+    PASS, FAIL, SKIP = STATUS_PASS, STATUS_FAIL, STATUS_SKIP
+    HOST, SKIPP = STATUS_HOST, STATUS_SKIP_PRECOND
+
+    def from_k(k: _K, true_code: int, false_code: int):
+        return jnp.where(k.t, jnp.int8(true_code),
+                         jnp.where(k.f, jnp.int8(false_code),
+                                   jnp.int8(HOST))).astype(jnp.int8)
+
+    def eval_status(t, node: StatusExpr, depth: int):
+        """Returns (status int8 [R]+[E]*depth, detail int8 same shape)."""
+        zeros_detail = None
+
+        def zd(ref):
+            return jnp.zeros(ref.shape, jnp.int8)
+
+        kind = node.kind
+        if kind == 'const':
+            n = t[next(iter(t))].shape[0]
+            shape = (n,) + (MAX_ELEMS,) * depth
+            s = jnp.full(shape, node.operand, jnp.int8)
+            return s, jnp.zeros(shape, jnp.int8)
+        if kind == 'leaf':
+            s = from_k(eval_expr(t, node.expr, depth), PASS, FAIL)
+            return s, zd(s)
+        if kind in ('precond', 'deny'):
+            if kind == 'precond':
+                s = from_k(eval_expr(t, node.expr, depth), PASS, SKIPP)
+            else:
+                s = from_k(eval_expr(t, node.expr, depth), FAIL, PASS)
+            d = zd(s)
+            # unresolvable condition variables preempt evaluation with the
+            # host's substitution-error ERROR; the first missing variable
+            # in traversal order picks the message (engine.py:388,431)
+            for gather, msg_idx in (node.operand or ()):
+                nf = t[f'{gather_prefix[gather]}_notfound']
+                hit = nf & (s != STATUS_VAR_ERR)
+                s = jnp.where(hit, jnp.int8(STATUS_VAR_ERR), s)
+                d = jnp.where(hit, jnp.int8(msg_idx), d)
+            return s, d
+        if kind == 'seq':
+            s, d = eval_status(t, node.children[0], depth)
+            for c in node.children[1:]:
+                cs, cd = eval_status(t, c, depth)
+                take = s == PASS
+                s = jnp.where(take, cs, s)
+                d = jnp.where(take, cd, d)
+            return s, d
+        if kind == 'any':
+            stats = [eval_status(t, c, depth)[0] for c in node.children]
+            ref = stats[0]
+            taken = jnp.zeros(ref.shape, bool)
+            pending_host = jnp.zeros(ref.shape, bool)
+            all_skip = jnp.ones(ref.shape, bool)
+            detail = jnp.zeros(ref.shape, jnp.int8)
+            for i, s_i in enumerate(stats):
+                this = (s_i == PASS) & ~taken & ~pending_host
+                detail = jnp.where(this, jnp.int8(i), detail)
+                taken = taken | this
+                pending_host = pending_host | (s_i == HOST)
+                all_skip = all_skip & (s_i == SKIP)
+            out = jnp.where(
+                taken, jnp.int8(PASS),
+                jnp.where(pending_host, jnp.int8(HOST),
+                          jnp.where(all_skip, jnp.int8(SKIP),
+                                    jnp.int8(FAIL)))).astype(jnp.int8)
+            return out, detail
+        if kind in ('cond', 'global', 'equality', 'negation'):
+            view = _View(t, slot_prefix[node.slot])
+            present = view.tag != TAG_MISSING
+            if view.tag.ndim - 1 < depth:
+                present = broadcast(present, depth)
+            if kind == 'negation':
+                s = jnp.where(present, jnp.int8(FAIL),
+                              jnp.int8(PASS)).astype(jnp.int8)
+                return s, zd(s)
+            sub_s, sub_d = eval_status(t, node.sub, depth)
+            if kind == 'equality':
+                s = jnp.where(present, sub_s, jnp.int8(PASS)).astype(jnp.int8)
+                return s, sub_d
+            # cond: absent→SKIP; sub FAIL/SKIP→SKIP; HOST→HOST
+            # global: absent→PASS; sub FAIL/SKIP→SKIP; HOST→HOST
+            absent_code = SKIP if kind == 'cond' else PASS
+            nonpass = jnp.where(sub_s == HOST, jnp.int8(HOST),
+                                jnp.int8(SKIP))
+            s = jnp.where(
+                ~present, jnp.int8(absent_code),
+                jnp.where(sub_s == PASS, jnp.int8(PASS),
+                          nonpass)).astype(jnp.int8)
+            return s, zd(s)
+        if kind in ('forall', 'exists', 'scalars'):
+            ap = array_prefix[node.slot.path]
+            arr_tag = t[f'{ap}_tag']
+            count = t[f'{ap}_count']
+            ovf = t[f'{ap}_overflow']
+            valid = jnp.arange(MAX_ELEMS) < count[..., None]
+            if kind == 'scalars':
+                k = eval_expr(t, node.expr, depth + 1)
+                any_fail = jnp.any(valid & k.f, axis=-1)
+                any_unk = jnp.any(valid & k.unknown(), axis=-1) | ovf
+                s = jnp.where(
+                    arr_tag != TAG_ARRAY, jnp.int8(FAIL),
+                    jnp.where(any_fail, jnp.int8(FAIL),
+                              jnp.where(any_unk, jnp.int8(HOST),
+                                        jnp.int8(PASS)))).astype(jnp.int8)
+                return s, zd(s)
+            sub_s, _ = eval_status(t, node.sub, depth + 1)
+            if kind == 'exists':
+                # reference: pkg/engine/anchor/handlers.go:228 — missing
+                # key passes, non-list fails, ≥1 element must validate
+                satisfied = jnp.any(valid & (sub_s == PASS), axis=-1)
+                maybe = jnp.any(valid & (sub_s == HOST), axis=-1) | ovf
+                s = jnp.where(
+                    arr_tag == TAG_MISSING, jnp.int8(PASS),
+                    jnp.where(arr_tag != TAG_ARRAY, jnp.int8(FAIL),
+                              jnp.where(satisfied, jnp.int8(PASS),
+                                        jnp.where(maybe, jnp.int8(HOST),
+                                                  jnp.int8(FAIL)))))
+                return s.astype(jnp.int8), zd(s)
+            # forall (validateArrayOfMaps, validate.go:218)
+            any_fail = jnp.any(valid & (sub_s == FAIL), axis=-1)
+            any_host = jnp.any(valid & (sub_s == HOST), axis=-1) | ovf
+            any_skip = jnp.any(valid & (sub_s == SKIP), axis=-1)
+            any_pass = jnp.any(valid & (sub_s == PASS), axis=-1)
+            s = jnp.where(
+                arr_tag != TAG_ARRAY, jnp.int8(FAIL),
+                jnp.where(any_fail, jnp.int8(FAIL),
+                          jnp.where(any_host, jnp.int8(HOST),
+                                    jnp.where(any_skip & ~any_pass,
+                                              jnp.int8(SKIP),
+                                              jnp.int8(PASS)))))
+            return s.astype(jnp.int8), zd(s)
+        if kind == 'trackfail':
+            sub_s, sub_d = eval_status(t, node.sub, depth)
+            guard = eval_expr(t, node.expr, depth)
+            s = jnp.where(sub_s == FAIL,
+                          jnp.where(guard.t, jnp.int8(FAIL),
+                                    jnp.int8(HOST)),
+                          sub_s).astype(jnp.int8)
+            return s, sub_d
+        raise ValueError(f'unknown status kind {kind!r}')
+
+    def evaluate(t: Dict[str, jnp.ndarray]):
+        leaf_cache.clear()
+        cond_cache.clear()
+        cols, dets = [], []
+        for prog in cps.programs:
+            s, d = eval_status(t, prog.status, 0)
+            cols.append(s)
+            dets.append(d)
+        if not cols:
+            n = t[next(iter(t))].shape[0] if t else 0
+            z = jnp.zeros((n, 0), jnp.int8)
+            return z, z
+        return jnp.stack(cols, axis=1), jnp.stack(dets, axis=1)
+
+    jitted = jax.jit(evaluate)
+
+    def call(t: Dict[str, Any]):
+        # i64 lanes are required: quantity milli-values span past 2^31.
+        # Scope x64 to this call instead of flipping the process-global
+        # flag at import time.
+        with enable_x64():
+            return jitted(t)
+
+    call.jitted = jitted
+    return call
+
+
+def enable_x64():
+    return jax.enable_x64()
 
 
 def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,

@@ -43,18 +43,26 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     all-reduce that replaces the reference's report aggregation fan-in
     (reference: pkg/controllers/report/aggregate/controller.go).
     """
+    from ..compiler.ir import N_STATUS_CODES
     from ..ops.eval import build_evaluator, enable_x64
     evaluate = build_evaluator(cps).jitted
+    n_codes = N_STATUS_CODES
 
     def step(tensors: Dict[str, jnp.ndarray]):
-        statuses = evaluate(tensors)
-        # per-rule verdict histogram; with GSPMD the partial sums are
-        # psum-reduced over ICI automatically
-        one_hot = jax.nn.one_hot(statuses, 3, dtype=jnp.int32)
+        tensors = dict(tensors)
+        rowmask = tensors.pop('__rowmask__', None)
+        statuses, details = evaluate(tensors)
+        # per-rule verdict histogram over the 5 status codes; with GSPMD
+        # the partial sums are psum-reduced over ICI automatically
+        one_hot = jax.nn.one_hot(statuses, n_codes, dtype=jnp.int32)
+        if rowmask is not None:
+            one_hot = one_hot * rowmask[:, None, None]
         summary = jnp.sum(one_hot, axis=0)
-        return statuses, summary
+        return statuses, details, summary
 
-    out_shardings = (NamedSharding(mesh, P(axis)), NamedSharding(mesh, P()))
+    out_shardings = (NamedSharding(mesh, P(axis)),
+                     NamedSharding(mesh, P(axis)),
+                     NamedSharding(mesh, P()))
     # input shardings propagate from the device_put placement in
     # shard_tensors; only outputs are constrained here
     jitted = jax.jit(step, out_shardings=out_shardings)
@@ -107,7 +115,10 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     n_dev = mesh.devices.size
     padded = pad_to_multiple(max(n, n_dev), n_dev)
     batch = encode_batch(resources, cps, padded_n=padded)
-    tensors = shard_tensors(batch.tensors(), mesh, axis)
+    raw = batch.tensors()
+    # padded rows are excluded from the verdict summary
+    raw['__rowmask__'] = (np.arange(padded) < n).astype(np.int32)
+    tensors = shard_tensors(raw, mesh, axis)
     step = _cached_sharded_evaluator(cps, mesh, axis)
-    statuses, summary = step(tensors)
+    statuses, details, summary = step(tensors)
     return np.asarray(statuses)[:n], np.asarray(summary)

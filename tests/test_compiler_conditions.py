@@ -1,0 +1,264 @@
+"""Device-vs-host equivalence for the v2 compiler surface:
+deny / preconditions / anyPattern / condition operators / scalar arrays.
+
+Every policy here must fully compile (no host-rule fallback) so the device
+path is genuinely exercised; the scanner may still re-run individual
+(resource, rule) pairs flagged HOST, which is part of the contract under
+test — results must be bit-identical to a pure host run either way.
+"""
+
+import random
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: precond-deny
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: deny-default-ns
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{request.object.metadata.namespace}}"
+            operator: NotEquals
+            value: kube-system
+      validate:
+        message: "default namespace is denied"
+        deny:
+          conditions:
+            any:
+              - key: "{{request.object.metadata.namespace}}"
+                operator: Equals
+                value: default
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: anyin-registries
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: registries
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "unknown registry"
+        deny:
+          conditions:
+            all:
+              - key: "{{request.object.spec.containers[].image}}"
+                operator: AnyNotIn
+                value: ["ghcr.io/*", "docker.io/*", "nginx*"]
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: numeric-conditions
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: replica-limit
+      match: {any: [{resources: {kinds: [Deployment]}}]}
+      preconditions:
+        all:
+          - key: "{{request.object.spec.replicas}}"
+            operator: GreaterThan
+            value: 0
+      validate:
+        message: "too many replicas"
+        deny:
+          conditions:
+            any:
+              - key: "{{request.object.spec.replicas}}"
+                operator: GreaterThan
+                value: 10
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: any-pattern
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: reg-or-tag
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "need registry or explicit tag"
+        anyPattern:
+          - spec:
+              containers:
+                - image: "ghcr.io/*"
+          - spec:
+              containers:
+                - image: "*:v?*"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: range-conditions
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: port-range
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "ports out of range"
+        deny:
+          conditions:
+            all:
+              - key: "{{request.object.spec.containers[].ports[].containerPort}}"
+                operator: AnyNotIn
+                value: "1024-65535"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: scalar-array
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: finalizer-prefix
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "bad finalizers"
+        pattern:
+          metadata:
+            finalizers:
+              - "kyverno.io/*"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: equals-shapes
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: host-network-eq
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "hostNetwork must be false-ish"
+        deny:
+          conditions:
+            any:
+              - key: "{{request.object.spec.hostNetwork}}"
+                operator: Equals
+                value: true
+              - key: "{{request.object.spec.priority}}"
+                operator: Equals
+                value: 1000000
+              - key: "{{request.object.spec.schedulerName}}"
+                operator: Equals
+                value: "evil-*"
+"""
+
+
+def load_pack():
+    return [Policy(d) for d in yaml.safe_load_all(PACK)]
+
+
+def make_pod(rng):
+    containers = []
+    for i in range(rng.randint(1, 4)):
+        c = {'name': f'c{i}',
+             'image': rng.choice([
+                 'nginx:1.25', 'nginx:latest', 'ghcr.io/a/b:v1', 'redis',
+                 'docker.io/library/nginx', 'quay.io/x/y:v2.0', '',
+                 'nginx', 'app:v3'])}
+        if rng.random() < 0.6:
+            c['ports'] = [
+                {'containerPort': rng.choice(
+                    [80, 443, 1024, 8080, 65535, 65536, 22, '8080'])}
+                for _ in range(rng.randint(1, 3))]
+        containers.append(c)
+    pod = {'apiVersion': 'v1', 'kind': 'Pod',
+           'metadata': {'name': f'p{rng.randint(0, 999)}',
+                        'namespace': rng.choice(
+                            ['default', 'kube-system', 'apps', ''])},
+           'spec': {'containers': containers}}
+    if rng.random() < 0.3:
+        pod['spec']['hostNetwork'] = rng.choice([True, False, 'true', 1])
+    if rng.random() < 0.3:
+        pod['spec']['priority'] = rng.choice(
+            [1000000, 0, 999999, '1000000', 1000000.0])
+    if rng.random() < 0.3:
+        pod['spec']['schedulerName'] = rng.choice(
+            ['evil-scheduler', 'default-scheduler', 'evil-', 'x'])
+    if rng.random() < 0.4:
+        pod['metadata']['finalizers'] = rng.sample(
+            ['kyverno.io/cleanup', 'kyverno.io/x', 'other.io/y', 'plain'],
+            rng.randint(1, 3))
+    if rng.random() < 0.1:
+        del pod['spec']['containers']
+    return pod
+
+
+def make_deployment(rng):
+    spec = {}
+    r = rng.choice([0, 1, 5, 10, 11, '3', '12', None, True, 10.0, 10.5])
+    if r is not None:
+        spec['replicas'] = r
+    return {'apiVersion': 'apps/v1', 'kind': 'Deployment',
+            'metadata': {'name': 'd', 'namespace': 'default'}, 'spec': spec}
+
+
+def host_results(engine, policies, resource):
+    host = {}
+    for policy in policies:
+        resp = engine.apply_background_checks(
+            PolicyContext(policy, new_resource=resource))
+        if resp.policy_response.rules:
+            host[policy.name] = {
+                r.name: (r.status, r.message)
+                for r in resp.policy_response.rules}
+    return host
+
+
+class TestConditionCompile:
+    def test_pack_fully_compiles(self):
+        cps = compile_policies(load_pack())
+        assert cps.host_rules == [], \
+            [r.get('name') for _, r, _ in cps.host_rules]
+        assert len(cps.programs) == 7
+
+
+class TestConditionEquivalence:
+    def test_device_vs_host_fuzz(self):
+        policies = load_pack()
+        engine = Engine()
+        rng = random.Random(11)
+        resources = [make_pod(rng) for _ in range(120)] + \
+                    [make_deployment(rng) for _ in range(40)]
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+        for resource, responses in zip(resources, scanned):
+            host = host_results(engine, policies, resource)
+            got = {}
+            for resp in responses:
+                if resp.policy_response.rules:
+                    got[resp.policy_response.policy_name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            assert got == host, f'divergence on {resource}'
+
+    def test_device_decides_most(self):
+        """The device must answer (not host-fallback) the bulk of the
+        simple verdicts, or the compiled path is useless."""
+        from kyverno_tpu.compiler.ir import STATUS_HOST
+        policies = load_pack()
+        rng = random.Random(13)
+        resources = [make_pod(rng) for _ in range(100)]
+        scanner = BatchScanner(policies)
+        status, detail, match = scanner.scan_statuses(resources)
+        applicable = match.sum()
+        host_rate = (match & (status == STATUS_HOST)).sum() / max(
+            applicable, 1)
+        assert host_rate < 0.1, f'device host-fallback rate {host_rate:.2f}'
