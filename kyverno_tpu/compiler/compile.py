@@ -48,6 +48,15 @@ def compile_policies(policies: List[Policy]) -> CompiledPolicySet:
     cps.policies = policies
     for p_idx, policy in enumerate(policies):
         for r_idx, rule in enumerate(compute_rules(policy)):
+            if not rule.get('validate'):
+                # mutate/generate-only rules produce no validate responses
+                # in a background scan (engine.py:254-260 _process_rule);
+                # verifyImages validation stays host-side (network-bound)
+                if any(iv.get('verifyDigest', True) or
+                       iv.get('required', True)
+                       for iv in rule.get('verifyImages') or []):
+                    cps.host_rules.append((p_idx, rule, policy))
+                continue
             try:
                 program = _compile_rule(cps, policy, p_idx, r_idx, rule)
             except CompileError:
@@ -447,36 +456,34 @@ def _compile_string_term(slot: Slot, term: str) -> BoolExpr:
 
 
 def _compile_wildcard_eq(slot: Slot, operand: str) -> BoolExpr:
-    """Classify a wildcard pattern into a vectorizable string class."""
+    """Classify a wildcard pattern into a vectorizable string class
+    (shared classification: ir.classify_wildcard)."""
+    from .ir import classify_wildcard
+
     def L(op, loperand=None):
         return BoolExpr.of(Leaf(slot, op, loperand))
 
     if len(operand.encode()) > STR_LEN:
         raise CompileError('operand longer than encoded string window')
-    has_star = '*' in operand
-    has_q = '?' in operand
-    if not has_star and not has_q:
+    kind, parts = classify_wildcard(operand)
+    if kind == 'eq':
         return L('eq_str', operand)
-    if operand == '*':
+    if kind == 'any':
         return L('any_str')
-    if operand == '?*':
+    if kind == 'nonempty':
         return L('nonempty')
-    if not has_q:
-        parts = operand.split('*')
-        if len(parts) == 2 and parts[0] and not parts[1]:
-            return L('prefix', parts[0])
-        if len(parts) == 2 and not parts[0] and parts[1]:
-            if len(parts[1].encode()) <= TAIL_LEN:
-                return L('suffix', parts[1])
-        if len(parts) == 3 and parts[0] and parts[2] and not parts[1] and \
-                len(parts[2].encode()) <= TAIL_LEN:
-            # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
-            return BoolExpr.all([
-                L('prefix', parts[0]), L('suffix', parts[2]),
-                L('min_len',
-                  len(parts[0].encode()) + len(parts[2].encode()))])
+    if kind == 'prefix':
+        return L('prefix', parts[0])
+    if kind == 'suffix':
+        return L('suffix', parts[0])
+    if kind == 'prefix_suffix':
+        # "a*b": prefix a AND suffix b AND len >= len(a)+len(b)
+        return BoolExpr.all([
+            L('prefix', parts[0]), L('suffix', parts[1]),
+            L('min_len',
+              len(parts[0].encode()) + len(parts[1].encode()))])
     # general wildcard: DP over the byte window (exact when the value fits
-    # the window or the pattern is tail-decidable; else → unknown → host)
+    # the window; else → unknown → host)
     return L('wildcard', operand)
 
 
@@ -571,13 +578,21 @@ def _normalize_values(value: Any) -> Tuple[Any, ...]:
     return (value,)
 
 
-def _compile_condition_key(key: Any) -> Tuple[GatherSlot, bool]:
-    """Compile a condition key — a single ``{{ jmespath }}`` over
-    ``request.object`` — into a gather program.
+# JMESPath custom functions whose results vary between evaluations —
+# encode-time projection would diverge from a host re-run
+_STATEFUL_FN_RE = re.compile(
+    r'\b(random|time_now|time_now_utc)\s*\(')
 
-    Returns (gather, scalar_key): scalar_key is True when the expression
-    cannot produce a list (no projections/multiselect), matching the host
-    operators' type dispatch on the queried value.
+
+def _compile_condition_key(key: Any) -> Tuple[GatherSlot, bool]:
+    """Compile a condition key — a single ``{{ jmespath }}`` — into a
+    gather projection.
+
+    The expression is evaluated verbatim at encode time by the in-repo
+    JMESPath interpreter against the same ``{'request': {'object': doc}}``
+    context the host engine builds for background scans
+    (engine/api.py:172-178), so gather semantics are host-exact for ANY
+    expression the parser accepts; only stateful functions are barred.
     """
     if not isinstance(key, str):
         raise CompileError('non-string condition key not vectorized')
@@ -587,57 +602,11 @@ def _compile_condition_key(key: Any) -> Tuple[GatherSlot, bool]:
     expr = m.group(1).strip()
     if '{{' in expr:
         raise CompileError('nested variables not vectorized')
-    from ..engine.jmespath.parser import parse as jp_parse
+    if _STATEFUL_FN_RE.search(expr):
+        raise CompileError('stateful function in condition key')
+    from ..engine.jmespath import compile as jp_compile
     try:
-        ast = jp_parse(expr)
+        jp_compile(expr)
     except Exception as e:  # noqa: BLE001 - parser errors → host
         raise CompileError(f'unparseable condition key: {e}')
-    first = []
-    scalar = _validate_gather_ast(ast, first)
-    if first[:2] != ['request', 'object']:
-        raise CompileError('condition key must address request.object')
-    return GatherSlot(expr), scalar
-
-
-def _validate_gather_ast(node: dict, fields: List[str]) -> bool:
-    """Check that a JMESPath AST is a shape the gather encoder supports;
-    collect leading field names into ``fields``. Returns True when the
-    expression is scalar-shaped (no projections), which drives the host
-    operators' type dispatch. Exotic shapes raise CompileError → host."""
-    t = node.get('type')
-    if t == 'subexpression':
-        scalar = True
-        for child in node['children']:
-            scalar = _validate_gather_ast(child, fields) and scalar
-        return scalar
-    if t == 'field':
-        fields.append(node['value'])
-        return True
-    if t == 'projection':
-        lhs, rhs = node['children']
-        _validate_gather_ast(lhs, fields)
-        if rhs.get('type') != 'identity':
-            _validate_gather_ast(rhs, [])
-        return False
-    if t == 'flatten':
-        _validate_gather_ast(node['children'][0], fields)
-        return False
-    if t == 'multi_select_list':
-        for child in node['children']:
-            if child.get('type') not in ('field', 'subexpression'):
-                raise CompileError('complex multiselect not vectorized')
-            _validate_gather_ast(child, [])
-        return False
-    if t == 'function_expression' and node.get('value') == 'keys' and \
-            len(node['children']) == 1 and \
-            node['children'][0].get('type') == 'current':
-        return False
-    if t == 'or_expression':
-        lhs, rhs = node['children']
-        if rhs.get('type') != 'literal' or isinstance(
-                rhs.get('value'), (dict, list)):
-            raise CompileError('non-literal || fallback not vectorized')
-        return _validate_gather_ast(lhs, fields)
-    if t == 'identity':
-        return True
-    raise CompileError(f'JMESPath shape {t!r} not vectorized')
+    return GatherSlot(expr), True

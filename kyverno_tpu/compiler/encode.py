@@ -31,6 +31,7 @@ Gathers additionally get:
 from __future__ import annotations
 
 import math
+from dataclasses import dataclass
 from fractions import Fraction
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -47,8 +48,54 @@ _INT64_MAX = (1 << 63) - 1
 
 _MISSING = object()
 
-# lane bundles a slot/gather may need (computed from the ops that read it)
-NEED_STR, NEED_MILLI, NEED_NANOS, NEED_WILD = 'str', 'milli', 'nanos', 'wild'
+# Per-slot/gather lane requirements, computed from exactly the ops the
+# evaluator performs against it (ops/eval.py read-set).  ``head`` is the
+# byte width of the string-head window — sized to the longest constant a
+# comparison needs, not a fixed 64 — which is the dominant memory/transfer
+# term of the encoded batch.
+@dataclass
+class LaneNeeds:
+    head: int = 0
+    tail: bool = False
+    length: bool = False
+    milli: bool = False
+    nanos: bool = False
+    wild: bool = False
+    lit_zero: bool = False
+
+    def merge(self, other: 'LaneNeeds') -> None:
+        self.head = max(self.head, other.head)
+        self.tail = self.tail or other.tail
+        self.length = self.length or other.length
+        self.milli = self.milli or other.milli
+        self.nanos = self.nanos or other.nanos
+        self.wild = self.wild or other.wild
+        self.lit_zero = self.lit_zero or other.lit_zero
+
+    def add_pattern(self, pattern: str) -> None:
+        """Lanes read by a constant glob comparison (ir.classify_wildcard
+        keeps this in sync with eval._View.match_const_pattern)."""
+        from .ir import classify_wildcard
+        kind, parts = classify_wildcard(pattern)
+        if kind == 'eq':
+            self.head = max(self.head, len(parts[0].encode('utf-8')))
+            self.length = True
+        elif kind == 'nonempty':
+            self.length = True
+        elif kind == 'prefix':
+            self.head = max(self.head, len(parts[0].encode('utf-8')))
+            self.length = True
+        elif kind == 'suffix':
+            self.tail = True
+            self.length = True
+        elif kind == 'prefix_suffix':
+            self.head = max(self.head, len(parts[0].encode('utf-8')))
+            self.tail = True
+            self.length = True
+        elif kind == 'dp':
+            self.head = STR_LEN
+            self.length = True
+        # 'any' reads only the tag
 
 
 def _go_float_str(v: float) -> str:
@@ -68,34 +115,42 @@ def _sprint(v: Any) -> str:
 
 
 class Lanes:
-    """numpy lane arrays for one slot or gather at a given shape."""
+    """numpy lane arrays for one slot or gather at a given shape, sized to
+    exactly the lanes (and head byte width) its comparisons read."""
 
-    def __init__(self, shape: Tuple[int, ...], needs: frozenset):
+    def __init__(self, shape: Tuple[int, ...], needs: LaneNeeds):
         self.needs = needs
         self.tag = np.zeros(shape, np.int8)
         z64 = lambda: np.zeros(shape, np.int64)  # noqa: E731
         zb = lambda: np.zeros(shape, bool)       # noqa: E731
-        self.milli = z64() if NEED_MILLI in needs else None
-        self.milli_ok = zb() if NEED_MILLI in needs else None
-        self.nanos = z64() if NEED_NANOS in needs else None
-        self.nanos_ok = zb() if NEED_NANOS in needs else None
-        # the string-parse flags ride with whichever numeric/string bundle
-        # reads them (cmp_qty gates on str_is_qty without string lanes)
-        self.str_is_int = zb() if needs & {NEED_STR, NEED_MILLI} else None
-        self.str_is_float = zb() if needs & {NEED_STR, NEED_MILLI} else None
-        self.str_is_qty = zb() if NEED_MILLI in needs else None
-        self.str_is_dur = zb() if NEED_NANOS in needs else None
-        if NEED_STR in needs:
+        self.milli = z64() if needs.milli else None
+        self.milli_ok = zb() if needs.milli else None
+        self.nanos = z64() if needs.nanos else None
+        self.nanos_ok = zb() if needs.nanos else None
+        # the string-parse flags ride with the numeric bundle that gates
+        # on them (eq_int/str_is_qty read milli; str_is_dur reads nanos)
+        self.str_is_int = zb() if needs.milli else None
+        self.str_is_float = zb() if needs.milli else None
+        self.str_is_qty = zb() if needs.milli else None
+        self.str_is_dur = zb() if needs.nanos else None
+        self.lit_zero = zb() if needs.lit_zero else None
+        if needs.length or needs.head or needs.tail:
             self.str_len = np.zeros(shape, np.int32)
-            self.str_head = np.zeros(shape + (STR_LEN,), np.uint8)
-            self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8)
         else:
-            self.str_len = self.str_head = self.str_tail = None
-        self.has_wild = zb() if NEED_WILD in needs else None
+            self.str_len = None
+        if needs.head:
+            # round the head window up for alignment / fewer pack groups
+            w = min(STR_LEN, (needs.head + 7) & ~7)
+            self.str_head = np.zeros(shape + (w,), np.uint8)
+        else:
+            self.str_head = None
+        self.str_tail = np.zeros(shape + (TAIL_LEN,), np.uint8) \
+            if needs.tail else None
+        self.has_wild = zb() if needs.wild else None
 
     _LANE_NAMES = ('tag', 'milli', 'milli_ok', 'nanos', 'nanos_ok',
                    'str_is_int', 'str_is_float', 'str_is_qty', 'str_is_dur',
-                   'str_len', 'str_head', 'str_tail', 'has_wild')
+                   'lit_zero', 'str_len', 'str_head', 'str_tail', 'has_wild')
 
     def tensors(self, prefix: str) -> Dict[str, np.ndarray]:
         out = {}
@@ -164,6 +219,8 @@ class Lanes:
             self.tag[idx] = TAG_STRING
             if self.str_len is not None:
                 self._encode_str(idx, value)
+            if self.lit_zero is not None and value == '0':
+                self.lit_zero[idx] = True
             if self.str_is_int is not None:
                 try:
                     int(value, 10)
@@ -223,27 +280,117 @@ class Lanes:
     def _encode_str(self, idx, s: str) -> None:
         b = s.encode('utf-8')
         self.str_len[idx] = len(b)
-        head = b[:STR_LEN]
-        self.str_head[idx][:len(head)] = np.frombuffer(head, np.uint8)
-        tail = b[-TAIL_LEN:]
-        self.str_tail[idx][TAIL_LEN - len(tail):] = \
-            np.frombuffer(tail, np.uint8)
+        if self.str_head is not None:
+            w = self.str_head.shape[-1]
+            head = b[:w]
+            self.str_head[idx][:len(head)] = np.frombuffer(head, np.uint8)
+        if self.str_tail is not None:
+            tail = b[-TAIL_LEN:]
+            self.str_tail[idx][TAIL_LEN - len(tail):] = \
+                np.frombuffer(tail, np.uint8)
 
 
 # ---------------------------------------------------------------------------
-# need analysis: which lanes each slot/gather requires
+# need analysis: which lanes each slot/gather requires (mirrors the exact
+# read-set of ops/eval.py for each leaf op / condition check)
 
-_STR_OPS = {'eq_str', 'prefix', 'suffix', 'min_len', 'nonempty', 'any_str',
-            'convertible', 'eq_int', 'eq_float', 'eq_null', 'wildcard'}
-_MILLI_OPS = {'eq_bool', 'eq_null', 'eq_int', 'eq_float', 'cmp_qty'}
-_NANOS_OPS = {'cmp_dur'}
+def _blen(s: str) -> int:
+    return min(len(s.encode('utf-8')), STR_LEN)
 
-_ALL_NEEDS = frozenset({NEED_STR, NEED_MILLI, NEED_NANOS})
+
+def _leaf_needs(op: str, operand: Any) -> LaneNeeds:
+    n = LaneNeeds()
+    if op in ('eq_bool', 'eq_int', 'eq_float', 'cmp_qty'):
+        n.milli = True
+    if op == 'eq_null':
+        n.milli = True
+        n.length = True
+    if op == 'cmp_dur':
+        n.nanos = True
+    if op in ('eq_str', 'prefix'):
+        n.head = _blen(operand)
+        n.length = True
+    if op == 'suffix':
+        n.tail = True
+        n.length = True
+    if op in ('min_len', 'nonempty'):
+        n.length = True
+    if op == 'wildcard':
+        n.head = STR_LEN
+        n.length = True
+    return n
+
+
+_IN_FAMILY = ('in', 'anyin', 'allin', 'notin', 'anynotin', 'allnotin')
+
+
+def _cond_needs(check) -> LaneNeeds:
+    """Gather lanes read by one condition check (ops/eval.py cond_tf)."""
+    from ..engine import pattern as leaf_pattern
+    n = LaneNeeds()
+    op = check.op
+    if op in ('equal', 'equals', 'notequal', 'notequals'):
+        if check.list_value:
+            for cv in check.values:
+                if isinstance(cv, str):
+                    n.head = max(n.head, _blen(cv))
+                    n.length = True
+                elif isinstance(cv, (bool, int, float)):
+                    n.milli = True
+        else:
+            v = check.values[0]
+            if isinstance(v, bool):
+                n.milli = True
+            elif isinstance(v, (int, float)):
+                n.milli = True
+                n.nanos = True
+                n.lit_zero = True
+            elif isinstance(v, str):
+                n.milli = True
+                n.nanos = True
+                n.lit_zero = True
+                n.length = True
+                n.head = max(n.head, _blen(v))
+                n.add_pattern(v)
+    elif op in _IN_FAMILY:
+        if check.list_value:
+            n.wild = True
+            n.length = True
+            for cv in check.values:
+                vs = cv if isinstance(cv, str) else _sprint(cv)
+                n.add_pattern(vs)
+                n.head = max(n.head, _blen(vs))
+        else:
+            v = check.values[0]
+            if isinstance(v, str):
+                n.length = True
+                n.head = max(n.head, _blen(v))
+                n.add_pattern(v)
+                if leaf_pattern.get_operator_from_string_pattern(v) == \
+                        leaf_pattern.OP_IN_RANGE:
+                    n.milli = True
+                    n.nanos = True
+                else:
+                    import json as _json
+                    try:
+                        arr = _json.loads(v)
+                    except ValueError:
+                        arr = None
+                    if isinstance(arr, list):
+                        for x in arr:
+                            if isinstance(x, str):
+                                n.head = max(n.head, _blen(x))
+    else:  # numeric comparisons
+        n.milli = True
+        n.nanos = True
+        n.lit_zero = True
+    return n
 
 
 def _analyze_needs(cps: CompiledPolicySet):
-    slot_needs: Dict[Slot, set] = {s: set() for s in cps.slots}
-    gather_needs: Dict[GatherSlot, set] = {g: set() for g in cps.gathers}
+    slot_needs: Dict[Slot, LaneNeeds] = {s: LaneNeeds() for s in cps.slots}
+    gather_needs: Dict[GatherSlot, LaneNeeds] = \
+        {g: LaneNeeds() for g in cps.gathers}
     array_paths: set = set()
 
     def visit_bool(expr):
@@ -251,20 +398,15 @@ def _analyze_needs(cps: CompiledPolicySet):
             return
         if expr.kind == 'leaf':
             leaf = expr.leaf
-            n = slot_needs.setdefault(leaf.slot, set())
-            if leaf.op in _STR_OPS:
-                n.add(NEED_STR)
-            if leaf.op in _MILLI_OPS:
-                n.add(NEED_MILLI)
-            if leaf.op in _NANOS_OPS:
-                n.add(NEED_NANOS)
+            if leaf.op == 'true':
+                return
+            n = slot_needs.setdefault(leaf.slot, LaneNeeds())
+            n.merge(_leaf_needs(leaf.op, leaf.operand))
             return
         if expr.kind == 'cond':
             g = expr.cond.gather
-            n = gather_needs.setdefault(g, set())
-            # conditions may compare strings (with wildcards both ways),
-            # quantities, and durations; encode everything they can read
-            n.update((NEED_STR, NEED_MILLI, NEED_NANOS, NEED_WILD))
+            n = gather_needs.setdefault(g, LaneNeeds())
+            n.merge(_cond_needs(expr.cond))
             return
         for c in expr.children:
             visit_bool(c)
@@ -347,16 +489,78 @@ class Batch:
         return out
 
 
+def _pow2_clamp(v: int, lo: int, hi: int) -> int:
+    v = max(v, 1)
+    return max(lo, min(hi, 1 << (v - 1).bit_length()))
+
+
+def _container_paths(cps: CompiledPolicySet, array_paths) -> List[Tuple]:
+    """All '*'-container prefixes referenced by slots or array nodes."""
+    out = set()
+    for slot in cps.slots:
+        for i, p in enumerate(slot.path):
+            if p == '*':
+                out.add(slot.path[:i])
+    for path in array_paths:
+        for i, p in enumerate(path):
+            if p == '*':
+                out.add(path[:i])
+        out.add(path)
+    return sorted(out)
+
+
+def _measure_elems(resources: List[dict], containers: List[Tuple]) -> int:
+    """Longest list under any container path (for the element width)."""
+    longest = 1
+    for doc in resources:
+        for path in containers:
+            if '*' in path:
+                star = path.index('*')
+                outer = _walk(doc, path[:star])
+                if not isinstance(outer, list):
+                    continue
+                rest = path[star + 1:]
+                for elem in outer[:MAX_ELEMS]:
+                    v = _walk(elem, rest) if isinstance(elem, dict) else None
+                    if isinstance(v, list):
+                        longest = max(longest, len(v))
+            else:
+                v = _walk(doc, path)
+                if isinstance(v, list):
+                    longest = max(longest, len(v))
+    return longest
+
+
 def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  padded_n: int = 0) -> Batch:
     n = max(len(resources), padded_n)
     batch = Batch(n)
     slot_needs, gather_needs, array_paths = _needs_cached(cps)
 
+    # element width: sized to the longest observed list (pow-2 clamped) —
+    # real batches rarely approach MAX_ELEMS, and the element axis
+    # multiplies every element-scoped lane's bytes
+    containers = _container_paths(cps, array_paths)
+    elems = _pow2_clamp(_measure_elems(resources, containers), 4, MAX_ELEMS)
+    batch.elems = elems
+
+    # gather projections are evaluated first so the gather width can be
+    # sized to the longest observed result list
+    gather_results = {
+        g: [_run_gather(searcher, doc) for doc in resources]
+        for g, searcher in ((g, _gather_searcher(g)) for g in cps.gathers)}
+    longest_g = 1
+    for results in gather_results.values():
+        for marker, value in results:
+            if marker == 'list':
+                longest_g = max(longest_g, len(value))
+    gwidth = _pow2_clamp(longest_g, 4, MAX_GATHER)
+    batch.gather_width = gwidth
+
     # array metadata channels (count/overflow/tag) for forall/exists nodes
     for path in array_paths:
         depth = sum(1 for p in path if p == '*')
-        shape = (n,) + (MAX_ELEMS,) * depth
+        shape = (n,) + (elems,) * depth
         batch.array_meta[path] = {
             'count': np.zeros(shape, np.int32),
             'overflow': np.zeros(shape, bool),
@@ -364,12 +568,11 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
         }
 
     for slot in cps.slots:
-        shape = (n,) + (MAX_ELEMS,) * slot.depth
-        batch.slot_lanes[slot] = Lanes(shape, frozenset(slot_needs[slot]))
+        shape = (n,) + (elems,) * slot.depth
+        batch.slot_lanes[slot] = Lanes(shape, slot_needs[slot])
 
     for g in cps.gathers:
-        batch.gather_lanes[g] = Lanes((n, MAX_GATHER),
-                                      frozenset(gather_needs[g]))
+        batch.gather_lanes[g] = Lanes((n, gwidth), gather_needs[g])
         batch.gather_meta[g] = {
             'kind': np.zeros(n, np.int8),
             'count': np.zeros(n, np.int32),
@@ -377,14 +580,14 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
             'notfound': np.zeros(n, bool),
         }
 
-    gather_progs = [(g, batch.gather_lanes[g], batch.gather_meta[g],
-                     _gather_searcher(g)) for g in cps.gathers]
-
     slot_plan = _slot_plan(cps, batch)
     for r, doc in enumerate(resources):
-        _encode_doc(r, doc, slot_plan, batch)
-        for g, lanes, meta, searcher in gather_progs:
-            _encode_gather(r, doc, lanes, meta, searcher)
+        _encode_doc(r, doc, slot_plan, batch, elems)
+    for g in cps.gathers:
+        lanes, meta = batch.gather_lanes[g], batch.gather_meta[g]
+        results = gather_results[g]
+        for r, (marker, value) in enumerate(results):
+            _fill_gather(r, marker, value, lanes, meta, gwidth)
     return batch
 
 
@@ -397,71 +600,88 @@ def _needs_cached(cps: CompiledPolicySet):
 
 
 def _slot_plan(cps: CompiledPolicySet, batch: Batch):
-    """Group slots by their first array prefix so arrays are walked once."""
-    plan = []
+    """Precomputed walk plan: scalar slots as flat (path, lanes) pairs;
+    element slots grouped by container prefix so each array (and each
+    element) is visited once for all the slots under it."""
+    plan0 = []
+    groups: Dict[Tuple[str, ...], dict] = {}
     for slot in cps.slots:
         lanes = batch.slot_lanes[slot]
-        plan.append((slot, lanes))
-    return plan
-
-
-def _encode_doc(r: int, doc: dict, slot_plan, batch: Batch) -> None:
-    for path, meta in batch.array_meta.items():
-        _encode_array_meta(r, doc, path, meta)
-    for slot, lanes in slot_plan:
-        if slot.depth == 0:
-            lanes.encode(r, _walk(doc, slot.path))
+        d = slot.depth
+        if d == 0:
+            plan0.append((slot.path, lanes))
             continue
         star1 = slot.path.index('*')
-        container = _walk(doc, slot.path[:star1])
-        rest1 = slot.path[star1 + 1:]
-        if not isinstance(container, list):
-            continue  # lanes stay TAG_MISSING; array guards handle it
-        if slot.depth == 1:
-            for e, elem in enumerate(container[:MAX_ELEMS]):
-                value = _walk(elem, rest1) if rest1 else elem
-                if rest1 and not isinstance(elem, dict):
-                    value = _MISSING
-                lanes.encode((r, e), value)
+        prefix, rest1 = slot.path[:star1], slot.path[star1 + 1:]
+        g = groups.setdefault(prefix, {'d1': [], 'd2': {}})
+        if d == 1:
+            g['d1'].append((rest1, lanes))
         else:
             star2 = rest1.index('*')
-            mid, rest2 = rest1[:star2], rest1[star2 + 1:]
-            for e, elem in enumerate(container[:MAX_ELEMS]):
-                inner = _walk(elem, mid) if isinstance(elem, dict) else _MISSING
+            g['d2'].setdefault(rest1[:star2], []).append(
+                (rest1[star2 + 1:], lanes))
+    # array-meta walk plan: (path, meta, star1 or None, rest)
+    metas = []
+    for path, meta in batch.array_meta.items():
+        if '*' in path:
+            star1 = path.index('*')
+            metas.append((path[:star1], meta, path[star1 + 1:]))
+        else:
+            metas.append((path, meta, None))
+    return plan0, groups, metas
+
+
+def _encode_doc(r: int, doc: dict, slot_plan, batch: Batch,
+                elems: int) -> None:
+    plan0, groups, metas = slot_plan
+    for path, meta, rest in metas:
+        if rest is None:
+            _set_array_meta(meta, r, _walk(doc, path), elems)
+            continue
+        container = _walk(doc, path)
+        if not isinstance(container, list):
+            continue
+        for e, elem in enumerate(container[:elems]):
+            value = _walk(elem, rest) if isinstance(elem, dict) else _MISSING
+            _set_array_meta(meta, (r, e), value, elems)
+    for path, lanes in plan0:
+        lanes.encode(r, _walk(doc, path))
+    for prefix, g in groups.items():
+        container = _walk(doc, prefix)
+        if not isinstance(container, list):
+            continue  # lanes stay TAG_MISSING; array guards handle it
+        d1, d2 = g['d1'], g['d2']
+        for e, elem in enumerate(container[:elems]):
+            re = (r, e)
+            is_map = isinstance(elem, dict)
+            for rest1, lanes in d1:
+                if not rest1:
+                    lanes.encode(re, elem)
+                else:
+                    lanes.encode(
+                        re, _walk(elem, rest1) if is_map else _MISSING)
+            for mid, members in d2.items():
+                inner = _walk(elem, mid) if is_map else _MISSING
                 if not isinstance(inner, list):
                     continue
-                for e2, elem2 in enumerate(inner[:MAX_ELEMS]):
-                    value = elem2
-                    if rest2:
-                        value = _walk(elem2, rest2) \
-                            if isinstance(elem2, dict) else _MISSING
-                    lanes.encode((r, e, e2), value)
+                for e2, elem2 in enumerate(inner[:elems]):
+                    ree = (r, e, e2)
+                    inner_map = isinstance(elem2, dict)
+                    for rest2, lanes in members:
+                        if not rest2:
+                            lanes.encode(ree, elem2)
+                        else:
+                            lanes.encode(ree, _walk(elem2, rest2)
+                                         if inner_map else _MISSING)
 
 
-def _encode_array_meta(r: int, doc: dict, path: Tuple[str, ...],
-                       meta: Dict[str, np.ndarray]) -> None:
-    depth = sum(1 for p in path if p == '*')
-    if depth == 0:
-        value = _walk(doc, path)
-        _set_array_meta(meta, r, value)
-        return
-    star1 = path.index('*')
-    container = _walk(doc, path[:star1])
-    rest = path[star1 + 1:]
-    if not isinstance(container, list):
-        return
-    for e, elem in enumerate(container[:MAX_ELEMS]):
-        value = _walk(elem, rest) if isinstance(elem, dict) else _MISSING
-        _set_array_meta(meta, (r, e), value)
-
-
-def _set_array_meta(meta, idx, value) -> None:
+def _set_array_meta(meta, idx, value, elems: int) -> None:
     if value is _MISSING:
         meta['tag'][idx] = TAG_MISSING
     elif isinstance(value, list):
         meta['tag'][idx] = TAG_ARRAY
-        meta['count'][idx] = min(len(value), MAX_ELEMS)
-        meta['overflow'][idx] = len(value) > MAX_ELEMS
+        meta['count'][idx] = min(len(value), elems)
+        meta['overflow'][idx] = len(value) > elems
     elif value is None:
         meta['tag'][idx] = TAG_NULL
     elif isinstance(value, dict):
@@ -476,31 +696,42 @@ def _gather_searcher(g: GatherSlot):
     return compiled
 
 
-def _encode_gather(r: int, doc: dict, lanes: Lanes, meta, searcher) -> None:
+def _run_gather(searcher, doc: dict):
+    """Evaluate one gather projection; returns a (marker, value) pair."""
     from ..engine.jmespath import NotFoundError
     try:
         result = searcher.search({'request': {'object': doc}})
     except NotFoundError:
         # missing path → the host's deterministic substitution-error ERROR
         # (engine.py:388; synthesized on device via STATUS_VAR_ERR)
-        meta['kind'][r] = 0
+        return 'notfound', None
+    except Exception:  # noqa: BLE001 - interpreter error → host decides
+        return 'raised', None
+    if result is None:
+        return 'null', None
+    if isinstance(result, list):
+        return 'list', result
+    return 'scalar', result
+
+
+def _fill_gather(r: int, marker: str, value, lanes: Lanes, meta,
+                 gwidth: int) -> None:
+    if marker == 'notfound':
         meta['notfound'][r] = True
         return
-    except Exception:  # noqa: BLE001 - interpreter error → host decides
-        meta['kind'][r] = 0
+    if marker == 'raised':
         meta['overflow'][r] = True
         return
-    if result is None:
-        meta['kind'][r] = 0
+    if marker == 'null':
         return
-    if isinstance(result, list):
+    if marker == 'list':
         meta['kind'][r] = 2
-        meta['count'][r] = min(len(result), MAX_GATHER)
-        if len(result) > MAX_GATHER:
+        meta['count'][r] = min(len(value), gwidth)
+        if len(value) > gwidth:
             meta['overflow'][r] = True
-        for e, value in enumerate(result[:MAX_GATHER]):
-            lanes.encode((r, e), value, sprint_form=True)
+        for e, v in enumerate(value[:gwidth]):
+            lanes.encode((r, e), v, sprint_form=True)
         return
     meta['kind'][r] = 1
     meta['count'][r] = 1
-    lanes.encode((r, 0), result, sprint_form=True)
+    lanes.encode((r, 0), value, sprint_form=True)

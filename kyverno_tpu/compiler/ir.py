@@ -133,6 +133,35 @@ LEAF_OPS = frozenset({
 CMP_GT, CMP_GE, CMP_LT, CMP_LE, CMP_EQ, CMP_NE = '>', '>=', '<', '<=', '==', '!='
 
 
+def classify_wildcard(operand: str):
+    """Classify a glob pattern into the cheapest vectorizable string op.
+
+    Returns (op, parts) with op ∈ {'eq','any','nonempty','prefix',
+    'suffix','prefix_suffix','dp'} — shared by the compiler, the
+    evaluator's constant matcher, and the lane-need analysis so all three
+    agree on which lanes (and byte widths) a comparison reads.
+    """
+    has_star = '*' in operand
+    has_q = '?' in operand
+    if not has_star and not has_q:
+        return 'eq', (operand,)
+    if operand == '*':
+        return 'any', ()
+    if operand == '?*':
+        return 'nonempty', ()
+    if not has_q:
+        parts = operand.split('*')
+        if len(parts) == 2 and parts[0] and not parts[1]:
+            return 'prefix', (parts[0],)
+        if len(parts) == 2 and not parts[0] and parts[1] and \
+                len(parts[1].encode()) <= TAIL_LEN:
+            return 'suffix', (parts[1],)
+        if len(parts) == 3 and parts[0] and parts[2] and not parts[1] and \
+                len(parts[2].encode()) <= TAIL_LEN:
+            return 'prefix_suffix', (parts[0], parts[2])
+    return 'dp', (operand,)
+
+
 @dataclass(frozen=True)
 class Leaf:
     """A scalar predicate on a slot."""

@@ -141,19 +141,35 @@ class BatchScanner:
 
     # -- device evaluation --------------------------------------------------
 
+    #: fixed device-chunk size: XLA compiles the evaluator once per
+    #: distinct batch shape, so large scans stream fixed-size chunks
+    CHUNK = int(__import__('os').environ.get('KTPU_SCAN_CHUNK', '8192'))
+
     def _device_statuses(self, resources: List[dict]):
-        if not self.cps.programs:
-            z = np.zeros((len(resources), 0), np.int8)
+        if not self.cps.programs or not resources:
+            z = np.zeros((len(resources), len(self.cps.programs)), np.int8)
             return z, z
-        n = len(resources)
-        # bucketed padding: trace once per power-of-two bucket; padded rows
-        # evaluate on zeroed (TAG_MISSING) slots and are sliced off
-        bucket = max(64, 1 << (n - 1).bit_length())
-        batch = encode_batch(resources, self.cps, padded_n=bucket)
         from ..ops.eval import shard_batch
-        tensors = shard_batch(batch.tensors(), self.mesh)
-        status, detail = self._evaluator(tensors)
-        return np.asarray(status)[:n], np.asarray(detail)[:n]
+        n = len(resources)
+        chunk = self.CHUNK
+        pending = []
+        for start in range(0, n, chunk):
+            part = resources[start:start + chunk]
+            # bucketed padding: power-of-two buckets below one chunk,
+            # exactly CHUNK otherwise → a handful of compiled shapes total
+            bucket = chunk if n > chunk else \
+                max(64, 1 << (len(part) - 1).bit_length())
+            batch = encode_batch(part, self.cps, padded_n=bucket)
+            tensors, layout = shard_batch(batch.tensors(), self.mesh)
+            # dispatch is async: the device evaluates this chunk while the
+            # host encodes the next one (the jax default double-buffering)
+            s, d = self._evaluator(tensors, layout)
+            pending.append((s, d, len(part)))
+        stats = [np.asarray(s)[:ln] for s, _, ln in pending]
+        dets = [np.asarray(d)[:ln] for _, d, ln in pending]
+        if len(stats) == 1:
+            return stats[0], dets[0]
+        return np.concatenate(stats), np.concatenate(dets)
 
     def scan_statuses(self, resources: List[dict]):
         """Raw (status, detail, match) matrices over all compiled programs

@@ -33,11 +33,10 @@ import jax
 import jax.numpy as jnp
 
 from ..compiler.encode import _needs_cached
-from ..compiler.ir import (MAX_ELEMS, MAX_GATHER, STR_LEN, TAG_ARRAY,
-                           TAG_BOOL, TAG_FLOAT, TAG_INT, TAG_MAP, TAG_MISSING,
-                           TAG_NULL, TAG_STRING, TAIL_LEN, BoolExpr,
-                           CompiledPolicySet, CondCheck, Leaf, RuleProgram,
-                           Slot, StatusExpr)
+from ..compiler.ir import (STR_LEN, TAG_ARRAY, TAG_BOOL, TAG_FLOAT, TAG_INT,
+                           TAG_MAP, TAG_MISSING, TAG_NULL, TAG_STRING,
+                           TAIL_LEN, BoolExpr, CompiledPolicySet, CondCheck,
+                           Leaf, RuleProgram, StatusExpr)
 from ..compiler.ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
                            STATUS_SKIP_PRECOND, STATUS_VAR_ERR)
 from ..engine import pattern as leaf_pattern
@@ -46,20 +45,10 @@ from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
 
 _I64_MAX = (1 << 63) - 1
-# milli magnitudes beyond this may round differently under the host's
-# float64 comparisons → undecidable on device
-_FLOAT_SAFE_MILLI = (1 << 53) * 1000
 
 
 def _const_bytes(s: str) -> bytes:
     return s.encode('utf-8')
-
-
-def _head_const(b: bytes) -> np.ndarray:
-    out = np.zeros(STR_LEN, np.uint8)
-    w = b[:STR_LEN]
-    out[:len(w)] = np.frombuffer(w, np.uint8)
-    return out
 
 
 class _K:
@@ -220,8 +209,7 @@ class _View:
     def is_zero_str(self):
         """The literal string '0' (excluded from operator duration parse,
         reference: pkg/engine/variables/operator/operator.go:80)."""
-        head0 = self.lane('str_head')[..., 0]
-        return (self.str_len == 1) & (head0 == ord('0'))
+        return self.lane('lit_zero')
 
     # duration usable under LEAF semantics (pattern.py _compare_duration:
     # the plain string form parses, '0' included).  The encoder sets
@@ -238,22 +226,35 @@ class _View:
     def eq_const(self, s: str) -> _K:
         b = _const_bytes(s)
         conv = self.convertible
-        if len(b) <= STR_LEN:
+        head = self.lane('str_head')
+        w = head.shape[-1]
+        if len(b) <= w:
+            # value bytes past str_len are zero, so a full-window compare
+            # against the zero-padded constant is exact string equality
+            const = np.zeros(w, np.uint8)
+            const[:len(b)] = np.frombuffer(b, np.uint8)
             hit = (conv & (self.str_len == len(b)) &
-                   jnp.all(self.lane('str_head') == _head_const(b), axis=-1))
+                   jnp.all(head == const, axis=-1))
             return _K(hit, ~hit & ~self.arrayish)
-        # constant longer than the window: tail+head agree → undecidable
-        maybe = conv & (self.str_len == len(b))
-        f = ~maybe & ~self.arrayish
-        return _K(jnp.zeros_like(maybe), f)
+        # constant longer than the head window: equal length + matching
+        # prefix is undecidable (analysis sizes windows so this is rare)
+        maybe = conv & (self.str_len == len(b)) & \
+            jnp.all(head == np.frombuffer(b[:w], np.uint8), axis=-1)
+        return _K(jnp.zeros_like(maybe), ~maybe & ~self.arrayish)
 
     def prefix_const(self, s: str) -> _K:
         b = _const_bytes(s)
         conv = self.convertible
-        head = self.lane('str_head')[..., :len(b)]
-        const = np.frombuffer(b, np.uint8)
-        hit = conv & (self.str_len >= len(b)) & jnp.all(head == const, axis=-1)
-        return _K(hit, ~hit & ~self.arrayish)
+        head = self.lane('str_head')
+        w = head.shape[-1]
+        if len(b) <= w:
+            const = np.frombuffer(b, np.uint8)
+            hit = conv & (self.str_len >= len(b)) & \
+                jnp.all(head[..., :len(b)] == const, axis=-1)
+            return _K(hit, ~hit & ~self.arrayish)
+        maybe = conv & (self.str_len >= len(b)) & \
+            jnp.all(head == np.frombuffer(b[:w], np.uint8), axis=-1)
+        return _K(jnp.zeros_like(maybe), ~maybe & ~self.arrayish)
 
     def suffix_const(self, s: str) -> _K:
         b = _const_bytes(s)
@@ -269,13 +270,14 @@ class _View:
         '?' meets non-ASCII bytes (rune vs byte width)."""
         conv = self.convertible
         head = self.lane('str_head')
-        vlen = jnp.minimum(self.str_len, STR_LEN)
+        w = head.shape[-1]
+        vlen = jnp.minimum(self.str_len, w)
         pb = _const_bytes(pattern)
         # dp[j]: pattern consumed so far matches value[:j]
         shape = head.shape[:-1]
-        dp = jnp.zeros(shape + (STR_LEN + 1,), bool)
+        dp = jnp.zeros(shape + (w + 1,), bool)
         dp = dp.at[..., 0].set(True)
-        pos_valid = jnp.arange(STR_LEN) < vlen[..., None]
+        pos_valid = jnp.arange(w) < vlen[..., None]
         for ch in pb:
             if ch == ord('*'):
                 dp = jnp.cumsum(dp.astype(jnp.int32), axis=-1) > 0
@@ -288,7 +290,7 @@ class _View:
                 dp = jnp.concatenate(
                     [jnp.zeros(shape + (1,), bool), step], axis=-1)
         matched = jnp.take_along_axis(dp, vlen[..., None], axis=-1)[..., 0]
-        in_window = self.str_len <= STR_LEN
+        in_window = self.str_len <= w
         if b'?' in bytes(pb):
             ascii_ok = jnp.all((head < 0x80) | ~pos_valid, axis=-1)
         else:
@@ -299,11 +301,30 @@ class _View:
         return _K(t, f)
 
     def match_const_pattern(self, s: str) -> _K:
-        """wildcard.match(const_pattern, value_string)."""
-        if '*' not in s and '?' not in s:
+        """wildcard.match(const_pattern, value_string) — classified into
+        the cheapest lane comparison (ir.classify_wildcard, shared with
+        the compiler and the lane-need analysis)."""
+        from ..compiler.ir import classify_wildcard
+        kind, parts = classify_wildcard(s)
+        if kind == 'eq':
             return self.eq_const(s)
-        if s == '*':
+        if kind == 'any':
             return _K(self.convertible, ~self.convertible & ~self.arrayish)
+        if kind == 'nonempty':
+            t = (self.is_tag(TAG_INT, TAG_FLOAT, TAG_BOOL) |
+                 ((self.tag == TAG_STRING) & (self.str_len > 0)))
+            return _K(t, ~t & ~self.arrayish)
+        if kind == 'prefix':
+            return self.prefix_const(parts[0])
+        if kind == 'suffix':
+            return self.suffix_const(parts[0])
+        if kind == 'prefix_suffix':
+            min_len = (len(parts[0].encode('utf-8')) +
+                       len(parts[1].encode('utf-8')))
+            ok = self.convertible & (self.str_len >= min_len)
+            conv_len = _K(ok, ~ok & ~self.arrayish)
+            return (self.prefix_const(parts[0]) &
+                    self.suffix_const(parts[1]) & conv_len)
         return self.wildcard_const(s)
 
 
@@ -469,9 +490,11 @@ def _scalar_eq_const(sv: _View, value: Any) -> _K:
         num_u = sv.numish & ~mok
         dur_key = ((sv.tag == TAG_STRING) & sv.lane('str_is_dur') &
                    ~sv.is_zero_str)
-        vd = Fraction(str(value)) * (10 ** 9)
-        if vd.denominator == 1:
-            dur_t = dur_key & sv.lane('nanos_ok') & (sv.nanos == int(vd))
+        # host truncates via float: _duration_pair does int(value * 1e9)
+        # (operators.py:111-117)
+        vd = int(value * 1e9)
+        if abs(vd) <= _I64_MAX:
+            dur_t = dur_key & sv.lane('nanos_ok') & (sv.nanos == vd)
         else:
             dur_t = jnp.zeros(shape, bool)
         dur_u = dur_key & ~sv.lane('nanos_ok')
@@ -489,16 +512,13 @@ def _scalar_eq_const(sv: _View, value: Any) -> _K:
 
 def _scalar_eq_str_const(sv: _View, value: str) -> _K:
     shape = sv.tag.shape
-    # key num: float(value) == float(key)  (operators.py:157-177)
+    # key num: float(value) == float(key)  (operators.py:157-177) —
+    # replicated as the identical float64 comparison on device
     try:
         fv = float(value)
-        target = Fraction(str(fv)) * 1000
-        mok = (sv.lane('milli_ok') &
-               (jnp.abs(sv.milli) <= _FLOAT_SAFE_MILLI))
-        if target.denominator == 1 and abs(target) <= _I64_MAX:
-            num_t = sv.numish & mok & (sv.milli == int(target))
-        else:
-            num_t = jnp.zeros(shape, bool)
+        mok = sv.lane('milli_ok') & (jnp.abs(sv.milli) <= (1 << 53))
+        key_f = sv.milli.astype(jnp.float64) / 1000.0
+        num_t = sv.numish & mok & (key_f == jnp.float64(fv))
         num_u = sv.numish & ~mok
     except ValueError:
         num_t = jnp.zeros(shape, bool)
@@ -547,7 +567,8 @@ def _scalar_eq_str_const(sv: _View, value: str) -> _K:
 def _list_eq_const(ev: _View, count, overflow, values: Tuple[Any, ...]) -> _K:
     """list key == list const (Python ``==`` semantics, elementwise)."""
     shape = count.shape
-    if len(values) > MAX_GATHER:
+    gwidth = ev.lane('tag').shape[-1]
+    if len(values) > gwidth:
         # visible lists are shorter → known unequal; overflowed lists have
         # an unknown true length → undecidable
         return _K(jnp.zeros(shape, bool), ~overflow)
@@ -683,7 +704,8 @@ def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
     scal_f = scalar & (~scalar_ok | member.f)
 
     # ---- list key: per-element membership, then quantify ----
-    elem_valid = jnp.arange(MAX_GATHER)[None, :] < count[:, None]
+    gwidth = t[f'{prefix}_tag'].shape[-1]
+    elem_valid = jnp.arange(gwidth) < count[..., None]
     shortcut = None
     if check.list_value:
         em = _both_dir_member(ev, check.values)
@@ -693,12 +715,12 @@ def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
         value = check.values[0]
         is_range = leaf_pattern.get_operator_from_string_pattern(value) == \
             leaf_pattern.OP_IN_RANGE
+        # single-element lists equal to the literal value string hit the
+        # keys[0]==value shortcut before range/JSON handling
+        # (operators.py:332-345,383-394)
+        eq0 = _View(t, prefix, 0).eq_const(value)
+        shortcut = (count == 1) & eq0.t
         if is_range:
-            # single-element lists equal to the literal range string hit
-            # the keys[0]==value shortcut before range validation
-            # (operators.py:332-338,383-387)
-            eq0 = _View(t, prefix, 0).eq_const(value)
-            shortcut = (count == 1) & eq0.t
             if op == 'anynotin':
                 em = string_pattern_tf(ev, value.replace('-', '!-', 1))
                 quant = 'any'
@@ -729,70 +751,96 @@ def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
 
 
 def _numeric_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
-    """GreaterThan / LessThan family (operators.py:413 _numeric)."""
+    """GreaterThan / LessThan family (operators.py:413 _numeric).
+
+    The host compares through float64 (``_cmp(op, float(key),
+    float(value))``, duration pairs via ``int(x * 1e9)`` then ``/ 1e9``);
+    the device replicates those float64 computations bit-for-bit (IEEE
+    semantics are identical), guarded to the ranges where the lanes
+    reconstruct the host's floats exactly.
+    """
     op = check.op
     kind = t[f'{prefix}_kind']
     shape = kind.shape
     sv = _View(t, prefix, 0)
     value = check.values[0]
-    cmpmap = {'greaterthan': '>', 'greaterthanorequals': '>=',
-              'lessthan': '<', 'lessthanorequals': '<='}
-    cmp = cmpmap[op]
+    cmp = {'greaterthan': '>', 'greaterthanorequals': '>=',
+           'lessthan': '<', 'lessthanorequals': '<='}[op]
     zeros = jnp.zeros(shape, bool)
     scalar = kind == 1
-    mok = sv.lane('milli_ok') & (jnp.abs(sv.milli) <= _FLOAT_SAFE_MILLI)
 
-    # key num -------------------------------------------------------------
-    num_key = sv.numish
-    if isinstance(value, bool):
-        num_t, num_u = zeros, zeros
-    elif isinstance(value, (int, float)):
-        c2, thr = _frac_thresholds(cmp, Fraction(str(value)) * 1000)
-        num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
-        num_u = num_key & ~mok
-    elif isinstance(value, str):
-        vd = _op_duration(value)
-        if vd is not None:
-            # duration pair with numeric key: key*1e9 vs vd
-            c2, thr = _frac_thresholds(cmp, Fraction(vd, 1000000))
-            num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
-            num_u = num_key & ~mok
-        else:
-            try:
-                fv = float(value)
-                c2, thr = _frac_thresholds(cmp, Fraction(str(fv)) * 1000)
-                num_t = num_key & mok & _cmp_arr(sv.milli, thr, c2)
-                num_u = num_key & ~mok
-            except ValueError:
-                num_t, num_u = zeros, zeros
-    else:
-        num_t, num_u = zeros, zeros
+    # f64(milli)/1000 == the host's float(key) whenever milli is exact and
+    # within 2^53 (single correctly-rounded division; see encode milli)
+    f53 = 1 << 53
+    mok = sv.lane('milli_ok') & (jnp.abs(sv.milli) <= f53)
+    key_f = sv.milli.astype(jnp.float64) / 1000.0
 
-    # key str -------------------------------------------------------------
-    is_str = sv.tag == TAG_STRING
-    dur_key = is_str & sv.lane('str_is_dur') & ~sv.is_zero_str
-    vd = None
+    def cmp_float(valid, ok, target_f):
+        """valid & host-float comparison against a float64 constant."""
+        return (valid & ok & _cmp_arr(key_f, jnp.float64(target_f), cmp),
+                valid & ~ok)
+
+    def cmp_duration_pair(valid, ok, vd: int):
+        """_duration_pair semantics: int(key*1e9)/1e9 cmp vd/1e9."""
+        kd = jnp.trunc(key_f * 1e9)
+        return (valid & ok & _cmp_arr(kd / 1e9, jnp.float64(vd / 1e9), cmp),
+                valid & ~ok)
+
+    # value-side constants, computed exactly as the host does
+    vd: Optional[int] = None        # duration nanos (int(value * 1e9))
+    vf: Optional[float] = None      # float(value)
+    vq = None                       # Quantity
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        vf = float(value)
     if isinstance(value, str):
         vd = _op_duration(value)
-        if vd is None and _is_op_num(value):
-            vd = None  # strings are never coerced on the value side here
-    elif isinstance(value, (int, float)) and not isinstance(value, bool):
-        vd = int(value * (10 ** 9))
-    if vd is not None:
-        dur_t = dur_key & sv.lane('nanos_ok') & _cmp_arr(sv.nanos, vd, cmp)
-        dur_u = dur_key & ~sv.lane('nanos_ok')
-        dur_decided = dur_key
-    else:
-        dur_t, dur_u = zeros, zeros
-        dur_decided = zeros
-    qty_key = is_str & sv.lane('str_is_qty') & ~dur_decided
-    vq = None
-    if isinstance(value, str):
         try:
             vq = Quantity.parse(value)
         except ValueError:
             vq = None
-    if vq is not None:
+        if vd is None:
+            try:
+                vf = float(value)
+            except ValueError:
+                vf = None
+
+    # ---- numeric key (operators.py:442 _numeric_num_key) ----
+    num_key = sv.numish
+    if isinstance(value, bool):
+        num_t, num_u = zeros, zeros
+    elif isinstance(value, (int, float)):
+        num_t, num_u = cmp_float(num_key, mok, vf)
+    elif isinstance(value, str) and vd is not None:
+        num_t, num_u = cmp_duration_pair(num_key, mok, vd)
+    elif isinstance(value, str) and vf is not None:
+        num_t, num_u = cmp_float(num_key, mok, vf)
+    else:
+        num_t, num_u = zeros, zeros
+
+    # ---- string key (operators.py:418-437) ----
+    is_str = sv.tag == TAG_STRING
+    dur_key = is_str & sv.lane('str_is_dur') & ~sv.is_zero_str
+    # duration pair: needs a duration/numeric value; kd is the parsed
+    # nanos (exact int) pushed through the host's / 1e9
+    if isinstance(value, str):
+        pair_vd = vd
+    elif isinstance(value, (int, float)) and not isinstance(value, bool):
+        pair_vd = int(value * 1e9)
+    else:
+        pair_vd = None
+    if pair_vd is not None:
+        nok = sv.lane('nanos_ok') & (jnp.abs(sv.nanos) <= f53)
+        kd_f = sv.nanos.astype(jnp.float64) / 1e9
+        dur_t = dur_key & nok & _cmp_arr(kd_f, jnp.float64(pair_vd / 1e9),
+                                         cmp)
+        dur_u = dur_key & ~nok
+        dur_decided = dur_key
+    else:
+        dur_t, dur_u = zeros, zeros
+        dur_decided = zeros
+    # quantity stage: exact rational compare (Quantity.cmp) via milli
+    qty_key = is_str & sv.lane('str_is_qty') & ~dur_decided
+    if isinstance(value, str) and vq is not None:
         c2, thr = _frac_thresholds(cmp, vq.value * 1000)
         qty_t = qty_key & sv.lane('milli_ok') & _cmp_arr(sv.milli, thr, c2)
         qty_u = qty_key & ~sv.lane('milli_ok')
@@ -800,28 +848,17 @@ def _numeric_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
     else:
         qty_t, qty_u = zeros, zeros
         qty_decided = zeros
-    # float(key) fallback, then semver, then False
+    # float(key) fallback: _numeric_num_key with the parsed float
     float_key = (is_str & sv.lane('str_is_float') & ~dur_decided &
                  ~qty_decided)
     if isinstance(value, bool):
         f_t, f_u = zeros, zeros
     elif isinstance(value, (int, float)):
-        c2, thr = _frac_thresholds(cmp, Fraction(str(value)) * 1000)
-        f_t = float_key & mok & _cmp_arr(sv.milli, thr, c2)
-        f_u = float_key & ~mok
-    elif isinstance(value, str):
-        fv = None
-        if _op_duration(value) is None:
-            try:
-                fv = float(value)
-            except ValueError:
-                fv = None
-        if fv is not None:
-            c2, thr = _frac_thresholds(cmp, Fraction(str(fv)) * 1000)
-            f_t = float_key & mok & _cmp_arr(sv.milli, thr, c2)
-            f_u = float_key & ~mok
-        else:
-            f_t, f_u = zeros, zeros
+        f_t, f_u = cmp_float(float_key, mok, float(value))
+    elif isinstance(value, str) and vd is not None:
+        f_t, f_u = cmp_duration_pair(float_key, mok, vd)
+    elif isinstance(value, str) and vf is not None:
+        f_t, f_u = cmp_float(float_key, mok, vf)
     else:
         f_t, f_u = zeros, zeros
     # semver stage: undecidable on device when the const side is semver
@@ -871,7 +908,6 @@ def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
                                      check.values)
         else:
             eq_list = _K.false_const(shape)  # list key vs scalar → False
-        nullk = kind == 0
         eq_t = (scalar & eq_scal.t) | ((kind == 2) & eq_list.t)
         eq_u = (scalar & eq_scal.unknown()) | ((kind == 2) & eq_list.unknown())
         res = _K(eq_t, ~eq_t & ~eq_u)
@@ -902,11 +938,13 @@ def build_evaluator(cps: CompiledPolicySet):
     _, _, array_paths = _needs_cached(cps)
     array_prefix = {path: f'a{j}' for j, path in enumerate(array_paths)}
 
+    dims: Dict[str, int] = {}
+
     def broadcast(arr, depth: int):
         """Append trailing element axes so arr has depth element dims."""
         while arr.ndim < depth + 1:
             arr = arr[..., None]
-        tgt = (arr.shape[0],) + (MAX_ELEMS,) * depth
+        tgt = (arr.shape[0],) + (dims['E'],) * depth
         return jnp.broadcast_to(arr, tgt)
 
     leaf_cache: Dict[Tuple[Leaf, int], _K] = {}
@@ -918,7 +956,7 @@ def build_evaluator(cps: CompiledPolicySet):
             return leaf_cache[key]
         if leaf.op == 'true':
             n = t[next(iter(t))].shape[0]
-            shape = (n,) + (MAX_ELEMS,) * depth
+            shape = (n,) + (dims['E'],) * depth
             out = _K.const(shape, True)
         else:
             view = _View(t, slot_prefix[leaf.slot])
@@ -942,7 +980,7 @@ def build_evaluator(cps: CompiledPolicySet):
                         continue
                     count = t[f'{ap}_count']
                     ovf = t[f'{ap}_overflow']
-                    valid = jnp.arange(MAX_ELEMS) < count[..., None]
+                    valid = jnp.arange(tt.shape[-1]) < count[..., None]
                     tt = jnp.all(tt | ~valid, axis=-1) & ~ovf
                     ff = jnp.any(ff & valid, axis=-1)
                 out = _K(tt, ff)
@@ -990,15 +1028,13 @@ def build_evaluator(cps: CompiledPolicySet):
 
     def eval_status(t, node: StatusExpr, depth: int):
         """Returns (status int8 [R]+[E]*depth, detail int8 same shape)."""
-        zeros_detail = None
-
         def zd(ref):
             return jnp.zeros(ref.shape, jnp.int8)
 
         kind = node.kind
         if kind == 'const':
             n = t[next(iter(t))].shape[0]
-            shape = (n,) + (MAX_ELEMS,) * depth
+            shape = (n,) + (dims['E'],) * depth
             s = jnp.full(shape, node.operand, jnp.int8)
             return s, jnp.zeros(shape, jnp.int8)
         if kind == 'leaf':
@@ -1074,7 +1110,7 @@ def build_evaluator(cps: CompiledPolicySet):
             arr_tag = t[f'{ap}_tag']
             count = t[f'{ap}_count']
             ovf = t[f'{ap}_overflow']
-            valid = jnp.arange(MAX_ELEMS) < count[..., None]
+            valid = jnp.arange(dims['E']) < count[..., None]
             if kind == 'scalars':
                 k = eval_expr(t, node.expr, depth + 1)
                 any_fail = jnp.any(valid & k.f, axis=-1)
@@ -1124,6 +1160,12 @@ def build_evaluator(cps: CompiledPolicySet):
     def evaluate(t: Dict[str, jnp.ndarray]):
         leaf_cache.clear()
         cond_cache.clear()
+        # element width of this batch (dynamic; see encode._measure_elems)
+        # — probed from slot ('sN_') or array ('aN_') tags, not gathers
+        dims['E'] = next(
+            (arr.shape[1] for name, arr in sorted(t.items())
+             if name.endswith('_tag') and arr.ndim >= 2
+             and name[0] in 'sa'), 0)
         cols, dets = [], []
         for prog in cps.programs:
             s, d = eval_status(t, prog.status, 0)
@@ -1135,16 +1177,24 @@ def build_evaluator(cps: CompiledPolicySet):
             return z, z
         return jnp.stack(cols, axis=1), jnp.stack(dets, axis=1)
 
-    jitted = jax.jit(evaluate)
+    layout_holder: Dict[str, Any] = {'layout': None}
 
-    def call(t: Dict[str, Any]):
+    def evaluate_packed(packed: Dict[str, jnp.ndarray]):
+        return evaluate(unpack_batch(packed, layout_holder['layout']))
+
+    jitted = jax.jit(evaluate_packed)
+
+    def call(packed: Dict[str, Any], layout: Dict[str, Tuple[str, int]]):
         # i64 lanes are required: quantity milli-values span past 2^31.
         # Scope x64 to this call instead of flipping the process-global
         # flag at import time.
+        layout_holder['layout'] = layout
         with enable_x64():
-            return jitted(t)
+            return jitted(packed)
 
     call.jitted = jitted
+    call.raw = evaluate
+    call.layout_holder = layout_holder
     return call
 
 
@@ -1152,16 +1202,46 @@ def enable_x64():
     return jax.enable_x64()
 
 
+def pack_batch(tensors: Dict[str, np.ndarray]):
+    """Stack same-shaped lanes into a handful of [K, R, ...] buffers.
+
+    The encoder produces hundreds of small per-lane arrays; transferring
+    each individually costs one host→device round trip apiece (dominant
+    over the remote-TPU tunnel).  Packing groups them by (dtype,
+    trailing shape) into a few big buffers; the evaluator unpacks with
+    static slices that XLA folds away.
+    """
+    groups: Dict[Tuple, List[Tuple[str, np.ndarray]]] = {}
+    for name, arr in sorted(tensors.items()):
+        key = (str(arr.dtype), arr.shape[1:])
+        groups.setdefault(key, []).append((name, arr))
+    packed: Dict[str, np.ndarray] = {}
+    layout: Dict[str, Tuple[str, int]] = {}
+    for gi, (key, members) in enumerate(sorted(groups.items())):
+        packed[f'pk{gi}'] = np.stack([arr for _, arr in members])
+        for mi, (name, _) in enumerate(members):
+            layout[name] = (f'pk{gi}', mi)
+    return packed, layout
+
+
+def unpack_batch(packed: Dict[str, Any],
+                 layout: Dict[str, Tuple[str, int]]) -> Dict[str, Any]:
+    return {name: packed[g][i] for name, (g, i) in layout.items()}
+
+
 def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
                 axis: str = 'data') -> Dict[str, Any]:
-    """Place batch tensors, optionally sharded over a 1-D mesh. int64
-    inputs are transferred inside an x64 scope so they are not downcast."""
+    """Pack + place batch tensors, optionally sharded over a 1-D mesh
+    (the resource axis of packed stacks is axis 1).  int64 inputs are
+    transferred inside an x64 scope so they are not downcast.  Returns
+    (packed_device_dict, layout)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+    packed, layout = pack_batch(tensors)
     with enable_x64():
         if mesh is None:
-            return {k: jnp.asarray(v) for k, v in tensors.items()}
+            return {k: jnp.asarray(v) for k, v in packed.items()}, layout
         out = {}
-        for k, v in tensors.items():
-            spec = P(axis, *([None] * (v.ndim - 1)))
+        for k, v in packed.items():
+            spec = P(None, axis, *([None] * (v.ndim - 2)))
             out[k] = jax.device_put(v, NamedSharding(mesh, spec))
-        return out
+        return out, layout

@@ -44,15 +44,15 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     (reference: pkg/controllers/report/aggregate/controller.go).
     """
     from ..compiler.ir import N_STATUS_CODES
-    from ..ops.eval import build_evaluator, enable_x64
-    evaluate = build_evaluator(cps).jitted
+    from ..ops.eval import build_evaluator, enable_x64, unpack_batch
+    evaluator = build_evaluator(cps)
     n_codes = N_STATUS_CODES
 
-    def step(tensors: Dict[str, jnp.ndarray]):
-        tensors = dict(tensors)
-        rowmask = tensors.pop('__rowmask__', None)
-        statuses, details = evaluate(tensors)
-        # per-rule verdict histogram over the 5 status codes; with GSPMD
+    def step(packed: Dict[str, jnp.ndarray]):
+        t = unpack_batch(packed, evaluator.layout_holder['layout'])
+        rowmask = t.pop('__rowmask__', None)
+        statuses, details = evaluator.raw(t)
+        # per-rule verdict histogram over the status codes; with GSPMD
         # the partial sums are psum-reduced over ICI automatically
         one_hot = jax.nn.one_hot(statuses, n_codes, dtype=jnp.int32)
         if rowmask is not None:
@@ -67,7 +67,8 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     # shard_tensors; only outputs are constrained here
     jitted = jax.jit(step, out_shardings=out_shardings)
 
-    def run(tensors):
+    def run(tensors, layout):
+        evaluator.layout_holder['layout'] = layout
         with enable_x64():
             return jitted(tensors)
 
@@ -118,7 +119,7 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     raw = batch.tensors()
     # padded rows are excluded from the verdict summary
     raw['__rowmask__'] = (np.arange(padded) < n).astype(np.int32)
-    tensors = shard_tensors(raw, mesh, axis)
+    tensors, layout = shard_tensors(raw, mesh, axis)
     step = _cached_sharded_evaluator(cps, mesh, axis)
-    statuses, details, summary = step(tensors)
+    statuses, details, summary = step(tensors, layout)
     return np.asarray(statuses)[:n], np.asarray(summary)

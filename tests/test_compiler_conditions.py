@@ -262,3 +262,77 @@ class TestConditionEquivalence:
         host_rate = (match & (status == STATUS_HOST)).sum() / max(
             applicable, 1)
         assert host_rate < 0.1, f'device host-fallback rate {host_rate:.2f}'
+
+
+class TestReviewRegressions:
+    """Divergences caught by adversarial review of the device operators."""
+
+    def _one_cond_policy(self, key, operator, value):
+        import yaml as _yaml
+        doc = {
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 't', 'annotations': {
+                'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+            'spec': {'rules': [{
+                'name': 'r',
+                'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+                'validate': {'message': 'm', 'deny': {'conditions': {
+                    'any': [{'key': key, 'operator': operator,
+                             'value': value}]}}}}]}}
+        return Policy(doc)
+
+    def _check(self, policy, resource):
+        engine = Engine()
+        host = engine.apply_background_checks(
+            PolicyContext(policy, new_resource=resource))
+        hmap = {r.name: (r.status, r.message)
+                for r in host.policy_response.rules}
+        scanner = BatchScanner([policy])
+        [resp_list] = scanner.scan([resource])
+        dmap = {}
+        for resp in resp_list:
+            dmap.update({r.name: (r.status, r.message)
+                         for r in resp.policy_response.rules})
+        assert dmap == hmap, (dmap, hmap)
+
+    def _pod(self, **labels):
+        return {'apiVersion': 'v1', 'kind': 'Pod',
+                'metadata': {'name': 'p', 'namespace': 'd',
+                             'labels': labels},
+                'spec': {'containers': [{'name': 'c', 'image': 'x'}]}}
+
+    def test_float_string_key_vs_duration_value(self):
+        p = self._one_cond_policy('{{request.object.metadata.labels.x}}',
+                                  'LessThan', '10s')
+        self._check(p, self._pod(x='1.5'))
+        self._check(p, self._pod(x='15'))
+        self._check(p, self._pod(x='0.3'))
+
+    def test_numeric_float_trunc_boundary(self):
+        # host: int(0.3 * 1e9) == 299999999 — the device must reproduce
+        # the same float64 truncation
+        p = self._one_cond_policy('{{request.object.metadata.labels.x}}',
+                                  'LessThan', 0.3)
+        self._check(p, self._pod(x='300ms'))
+        self._check(p, self._pod(x='299999999ns'))
+
+    def test_equals_float_value_vs_duration_key(self):
+        p = self._one_cond_policy('{{request.object.metadata.labels.x}}',
+                                  'Equals', 1.000000007)
+        self._check(p, self._pod(x='1000000006ns'))
+        self._check(p, self._pod(x='1000000007ns'))
+
+    def test_single_elem_list_json_literal_shortcut(self):
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AllIn', '["a","b"]')
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c', 'image': '["a","b"]'}]
+        self._check(p, pod)
+        pod['spec']['containers'] = [{'name': 'c', 'image': 'a'}]
+        self._check(p, pod)
+
+    def test_empty_scan_statuses(self):
+        scanner = BatchScanner(load_pack())
+        status, detail, match = scanner.scan_statuses([])
+        assert status.shape[0] == 0 and match.shape[0] == 0
