@@ -85,6 +85,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     units: List[StatusExpr] = []
     pass_messages = (f"validation rule '{name}' passed.",)
     error_messages: List[str] = []
+    pss = None
 
     # preconditions gate everything (engine.py Validator.validate order)
     if rule.get('preconditions') is not None:
@@ -117,7 +118,12 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
             for i in range(len(pats)))
     elif validate.get('podSecurity') is not None:
         from .pss_compile import compile_pod_security
-        units.append(compile_pod_security(cps, validate['podSecurity']))
+        units.append(compile_pod_security(cps, validate['podSecurity'],
+                                          rule))
+        # PSS pass messages are capitalized (engine.py:605)
+        pass_messages = (f"Validation rule '{name}' passed.",)
+        ps = validate['podSecurity']
+        pss = (ps.get('level', ''), ps.get('version', ''))
     else:
         raise CompileError('no compilable validate sub-key')
 
@@ -126,7 +132,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         policy_index=p_idx, rule_index=r_idx,
         status=StatusExpr.seq(units),
         pass_messages=pass_messages,
-        error_messages=tuple(error_messages),
+        error_messages=tuple(error_messages), pss=pss,
         background=policy.background, rule_raw=rule)
 
 

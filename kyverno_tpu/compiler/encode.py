@@ -300,8 +300,12 @@ def _blen(s: str) -> int:
 
 def _leaf_needs(op: str, operand: Any) -> LaneNeeds:
     n = LaneNeeds()
-    if op in ('eq_bool', 'eq_int', 'eq_float', 'cmp_qty'):
+    if op in ('eq_bool', 'eq_int', 'eq_float', 'cmp_qty',
+              'is_true', 'is_false', 'is_zero_num'):
         n.milli = True
+    if op == 'truthy':
+        n.milli = True
+        n.length = True
     if op == 'eq_null':
         n.milli = True
         n.length = True
@@ -408,6 +412,8 @@ def _analyze_needs(cps: CompiledPolicySet):
             n = gather_needs.setdefault(g, LaneNeeds())
             n.merge(_cond_needs(expr.cond))
             return
+        if expr.kind in ('any_elem', 'all_elem') and expr.slot is not None:
+            array_paths.add(expr.slot.path)
         for c in expr.children:
             visit_bool(c)
 
@@ -691,6 +697,9 @@ def _set_array_meta(meta, idx, value, elems: int) -> None:
 
 
 def _gather_searcher(g: GatherSlot):
+    if g.expr.startswith('__pss:'):
+        from .pss_compile import virtual_searcher
+        return virtual_searcher(g.expr)
     from ..engine.jmespath import compile as jp_compile
     compiled = jp_compile(g.expr)
     return compiled

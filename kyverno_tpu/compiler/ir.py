@@ -128,6 +128,11 @@ LEAF_OPS = frozenset({
     'suffix',       # operand: str (≤ TAIL_LEN bytes)
     'min_len',      # operand: int (byte length lower bound)
     'wildcard',     # operand: str pattern with */?; DP over the byte window
+    # Python-semantics predicates for the PSS check library (pss_compile):
+    'truthy',       # bool(value): non-zero number / non-empty string / True
+    'is_true',      # value is True (strict bool identity)
+    'is_false',     # value is False
+    'is_zero_num',  # value == 0 under Python numerics (0, 0.0, False)
 })
 
 CMP_GT, CMP_GE, CMP_LT, CMP_LE, CMP_EQ, CMP_NE = '>', '>=', '<', '<=', '==', '!='
@@ -194,11 +199,17 @@ class CondCheck:
 @dataclass(frozen=True)
 class BoolExpr:
     """AND/OR/NOT tree over leaves / condition checks (Kleene 3-valued on
-    device: each node evaluates to (true-known, false-known))."""
-    kind: str                      # 'leaf' | 'cond' | 'and' | 'or' | 'not'
+    device: each node evaluates to (true-known, false-known)).
+
+    'any_elem' / 'all_elem' quantify their single child over the valid
+    elements of the array at ``slot`` (one depth level deeper); a missing
+    or null array is vacuous (∃ → False, ∀ → True), mirroring the PSS
+    library's ``spec.get(field) or []`` walks (pss/checks.py)."""
+    kind: str   # 'leaf' | 'cond' | 'and' | 'or' | 'not' | *_elem
     leaf: Optional[Leaf] = None
     cond: Optional[CondCheck] = None
     children: Tuple['BoolExpr', ...] = ()
+    slot: Optional[Slot] = None    # quantifier array slot
 
     @staticmethod
     def of(leaf: Leaf) -> 'BoolExpr':
@@ -303,6 +314,9 @@ class RuleProgram:
     # substitution-error messages for unresolvable condition variables,
     # indexed by ``detail`` on STATUS_VAR_ERR (engine.py:388-391,431-434)
     error_messages: Tuple[str, ...] = ()
+    # (level, version) for podSecurity rules — synthesized PASS responses
+    # carry {'level', 'version', 'checks': []} (engine.py:592-605)
+    pss: Optional[Tuple[str, str]] = None
     background: bool = True
     # the original rule dict (for host-side match evaluation + fallback)
     rule_raw: Optional[dict] = None

@@ -220,6 +220,10 @@ class BatchScanner:
                     rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
                                       prog.pass_messages[int(detail[i, j])],
                                       RuleStatus.PASS)
+                    if prog.pss is not None:
+                        rr.pod_security_checks = {
+                            'level': prog.pss[0], 'version': prog.pss[1],
+                            'checks': []}
                 elif st == STATUS_SKIP_PRECOND:
                     rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
                                       PRECONDITIONS_SKIP_MESSAGE,

@@ -401,6 +401,28 @@ def leaf_op_tf(v: _View, op: str, operand: Any) -> _K:
         return _K(t, ~t & ~arr)
     if op == 'wildcard':
         return v.wildcard_const(operand)
+    if op == 'truthy':
+        # Python bool(value): maps/arrays are truthy only when non-empty,
+        # which the lanes can't see → unknown
+        mok = v.lane('milli_ok')
+        num = v.is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT)
+        t = (num & ((v.milli != 0) | ~mok)) | \
+            ((v.tag == TAG_STRING) & (v.str_len > 0))
+        f = v.nullish | (num & mok & (v.milli == 0)) | \
+            ((v.tag == TAG_STRING) & (v.str_len == 0))
+        return _K(t, f)
+    if op == 'is_true':
+        # `value is True` — identity, so every non-bool is known-False
+        t = (v.tag == TAG_BOOL) & (v.milli != 0)
+        return _K(t, ~t)
+    if op == 'is_false':
+        t = (v.tag == TAG_BOOL) & (v.milli == 0)
+        return _K(t, ~t)
+    if op == 'is_zero_num':
+        # Python ==: 0 == 0.0 == False; strings/maps/arrays never equal 0
+        num = v.is_tag(TAG_BOOL, TAG_INT, TAG_FLOAT)
+        t = num & v.lane('milli_ok') & (v.milli == 0)
+        return _K(t, ~t)
     raise ValueError(f'unknown leaf op {op!r}')
 
 
@@ -1009,6 +1031,24 @@ def build_evaluator(cps: CompiledPolicySet):
             if depth > 0:
                 out = _K(broadcast(out.t, depth), broadcast(out.f, depth))
             return out
+        if expr.kind in ('any_elem', 'all_elem'):
+            sub = eval_expr(t, expr.children[0], depth + 1)
+            ap = array_prefix[expr.slot.path]
+            arr_tag = t[f'{ap}_tag']
+            count = t[f'{ap}_count']
+            ovf = t[f'{ap}_overflow']
+            valid = jnp.arange(sub.t.shape[-1]) < count[..., None]
+            # missing/null arrays walk as [] (pss/checks.py `or []`);
+            # map/scalar values would crash the host walk → undecidable
+            known_arr = (arr_tag == TAG_ARRAY) | (arr_tag == TAG_MISSING) | \
+                (arr_tag == TAG_NULL)
+            if expr.kind == 'any_elem':
+                tt = jnp.any(valid & sub.t, axis=-1)
+                ff = jnp.all(~valid | sub.f, axis=-1) & ~ovf
+            else:
+                tt = jnp.all(~valid | sub.t, axis=-1) & ~ovf
+                ff = jnp.any(valid & sub.f, axis=-1)
+            return _K(known_arr & tt, known_arr & ff)
         parts = [eval_expr(t, c, depth) for c in expr.children]
         if expr.kind == 'and':
             return _k_all(parts)

@@ -1,0 +1,212 @@
+"""Device-vs-host equivalence for the compiled PSS check library
+(compiler/pss_compile.py vs pss/checks.py)."""
+
+import random
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.ir import STATUS_HOST
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: pss-baseline
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: baseline
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        podSecurity:
+          level: baseline
+          version: latest
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: pss-restricted
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: restricted
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        podSecurity:
+          level: restricted
+          version: latest
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: pss-deployments
+spec:
+  rules:
+    - name: restricted-deploy
+      match: {any: [{resources: {kinds: [Deployment]}}]}
+      validate:
+        podSecurity:
+          level: restricted
+          version: latest
+"""
+
+
+def load_pack():
+    return [Policy(d) for d in yaml.safe_load_all(PACK)]
+
+
+_CAPS = ['NET_ADMIN', 'CHOWN', 'KILL', 'ALL', 'SETUID', 'SYS_TIME',
+         'NET_BIND_SERVICE']
+_SECCOMP = ['RuntimeDefault', 'Localhost', 'Unconfined', None, 'Other']
+
+
+def make_pod(rng):
+    containers = []
+    for i in range(rng.randint(1, 3)):
+        c = {'name': f'c{i}', 'image': 'app:v1'}
+        sc = {}
+        if rng.random() < 0.3:
+            sc['privileged'] = rng.choice([True, False, 'true', 1])
+        if rng.random() < 0.5:
+            sc['allowPrivilegeEscalation'] = rng.choice(
+                [True, False, None, 'false'])
+        if rng.random() < 0.5:
+            caps = {}
+            if rng.random() < 0.8:
+                caps['add'] = rng.sample(_CAPS, rng.randint(0, 3))
+            if rng.random() < 0.8:
+                caps['drop'] = rng.choice(
+                    [['ALL'], [], ['KILL'], ['ALL', 'KILL'], None])
+            sc['capabilities'] = caps
+        if rng.random() < 0.4:
+            sc['runAsNonRoot'] = rng.choice([True, False, None, 'true'])
+        if rng.random() < 0.3:
+            sc['runAsUser'] = rng.choice([0, 1000, 0.0, False, '0'])
+        if rng.random() < 0.3:
+            sc['seccompProfile'] = {'type': rng.choice(_SECCOMP)}
+        if rng.random() < 0.2:
+            sc['seLinuxOptions'] = {
+                'type': rng.choice(['container_t', 'spc_t', '', None]),
+                'user': rng.choice(['', 'sys', None]),
+            }
+        if rng.random() < 0.15:
+            sc['procMount'] = rng.choice(['Default', 'Unmasked', '', None])
+        if rng.random() < 0.1:
+            sc['windowsOptions'] = {'hostProcess': rng.choice(
+                [True, False, 'true'])}
+        if sc:
+            c['securityContext'] = sc
+        if rng.random() < 0.3:
+            c['ports'] = [{'containerPort': 80,
+                           'hostPort': rng.choice([0, 80, None])}]
+        containers.append(c)
+    spec = {'containers': containers}
+    if rng.random() < 0.2:
+        spec['initContainers'] = [dict(containers[0], name='init0')]
+    if rng.random() < 0.15:
+        spec['hostNetwork'] = rng.choice([True, False, 1, ''])
+    if rng.random() < 0.1:
+        spec['hostPID'] = True
+    if rng.random() < 0.3:
+        vols = []
+        for v in range(rng.randint(1, 2)):
+            vols.append(rng.choice([
+                {'name': f'v{v}', 'emptyDir': {}},
+                {'name': f'v{v}', 'hostPath': {'path': '/x'}},
+                {'name': f'v{v}', 'nfs': {'server': 's', 'path': '/'}},
+                {'name': f'v{v}', 'configMap': {'name': 'cm'}}]))
+        spec['volumes'] = vols
+    if rng.random() < 0.2:
+        spec['securityContext'] = {
+            'runAsNonRoot': rng.choice([True, False, None]),
+            'sysctls': rng.choice([
+                None, [], [{'name': 'kernel.shm_rmid_forced', 'value': '1'}],
+                [{'name': 'kernel.msgmax', 'value': '1'}]]),
+        }
+    pod = {'apiVersion': 'v1', 'kind': 'Pod',
+           'metadata': {'name': f'p{rng.randint(0, 999)}', 'namespace': 'd'},
+           'spec': spec}
+    if rng.random() < 0.15:
+        pod['metadata']['annotations'] = {
+            'container.apparmor.security.beta.kubernetes.io/c0':
+                rng.choice(['runtime/default', 'localhost/x', 'unconfined',
+                            '']),
+            'other': 'x'}
+    return pod
+
+
+def make_deployment(rng):
+    pod = make_pod(rng)
+    return {'apiVersion': 'apps/v1', 'kind': 'Deployment',
+            'metadata': {'name': 'd', 'namespace': 'd'},
+            'spec': {'replicas': 1,
+                     'template': {'metadata': pod['metadata'],
+                                  'spec': pod['spec']}}}
+
+
+class TestPSSCompile:
+    def test_pack_fully_compiles(self):
+        cps = compile_policies(load_pack())
+        assert cps.host_rules == [], \
+            [r.get('name') for _, r, _ in cps.host_rules]
+        assert len(cps.programs) == 3
+
+    def test_excludes_fall_back_to_host(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: x, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: r
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        podSecurity:
+          level: baseline
+          exclude: [{controlName: Capabilities}]
+"""))
+        cps = compile_policies([policy])
+        assert len(cps.host_rules) == 1
+
+
+class TestPSSEquivalence:
+    def test_device_vs_host_fuzz(self):
+        policies = load_pack()
+        engine = Engine()
+        rng = random.Random(23)
+        resources = [make_pod(rng) for _ in range(150)] + \
+                    [make_deployment(rng) for _ in range(50)]
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+        for resource, responses in zip(resources, scanned):
+            host = {}
+            for policy in policies:
+                resp = engine.apply_background_checks(
+                    PolicyContext(policy, new_resource=resource))
+                if resp.policy_response.rules:
+                    host[policy.name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            got = {}
+            for resp in responses:
+                if resp.policy_response.rules:
+                    got[resp.policy_response.policy_name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            assert got == host, f'divergence on {resource}'
+
+    def test_device_decides_most(self):
+        policies = load_pack()
+        rng = random.Random(29)
+        resources = [make_pod(rng) for _ in range(100)]
+        scanner = BatchScanner(policies)
+        status, detail, match = scanner.scan_statuses(resources)
+        applicable = match.sum()
+        host_rate = (match & (status == STATUS_HOST)).sum() / max(
+            applicable, 1)
+        assert host_rate < 0.05, f'device host-fallback rate {host_rate:.2f}'
