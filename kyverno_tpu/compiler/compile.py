@@ -73,10 +73,8 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     validate = rule['validate']
     if rule.get('context'):
         raise CompileError('context entries require the host engine')
-    unsupported = [k for k in ('foreach', 'manifests')
-                   if validate.get(k) is not None]
-    if unsupported:
-        raise CompileError(f'unsupported validate type {unsupported}')
+    if validate.get('manifests') is not None:
+        raise CompileError('manifests rules require the host engine')
     if not isinstance(rule.get('match', {}) or {}, dict) or \
             not isinstance(rule.get('exclude', {}) or {}, dict):
         raise CompileError('bad match/exclude block')
@@ -86,6 +84,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     pass_messages = (f"validation rule '{name}' passed.",)
     error_messages: List[str] = []
     pss = None
+    skip_message = None
 
     # preconditions gate everything (engine.py Validator.validate order)
     if rule.get('preconditions') is not None:
@@ -117,6 +116,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
             f"validation rule '{name}' anyPattern[{i}] passed."
             for i in range(len(pats)))
     elif validate.get('podSecurity') is not None:
+        # host dispatch order: podSecurity before foreach (engine.py:403)
         from .pss_compile import compile_pod_security
         units.append(compile_pod_security(cps, validate['podSecurity'],
                                           rule))
@@ -124,6 +124,11 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         pass_messages = (f"Validation rule '{name}' passed.",)
         ps = validate['podSecurity']
         pss = (ps.get('level', ''), ps.get('version', ''))
+    elif validate.get('foreach') is not None:
+        units.append(_compile_foreach(cps, validate['foreach']))
+        # foreach pass/skip messages are static (engine.py:625-630)
+        pass_messages = ('rule passed',)
+        skip_message = 'rule skipped'
     else:
         raise CompileError('no compilable validate sub-key')
 
@@ -133,6 +138,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         status=StatusExpr.seq(units),
         pass_messages=pass_messages,
         error_messages=tuple(error_messages), pss=pss,
+        skip_message=skip_message,
         background=policy.background, rule_raw=rule)
 
 
@@ -505,27 +511,38 @@ _SUPPORTED_COND_OPS = {
 }
 
 
-def _compile_conditions(cps: CompiledPolicySet, conditions: Any) -> BoolExpr:
+def _compile_conditions(cps: CompiledPolicySet, conditions: Any,
+                        elem_list_expr: Optional[str] = None,
+                        err_gathers: Optional[List] = None) -> BoolExpr:
     """Compile any/all condition blocks to a BoolExpr
-    (semantics: kyverno_tpu/engine/operators.py evaluate_conditions)."""
+    (semantics: kyverno_tpu/engine/operators.py evaluate_conditions).
+    With ``elem_list_expr`` set, conditions compile at foreach-element
+    scope (either side may be an element variable)."""
+    def one(c):
+        if elem_list_expr is not None:
+            if not isinstance(c, dict):
+                raise CompileError('bad condition')
+            return _compile_condition_elem(cps, elem_list_expr, c,
+                                           err_gathers)
+        return _compile_condition(cps, c)
+
     if conditions is None:
         return BoolExpr.of(Leaf(Slot(()), 'true'))
     if isinstance(conditions, dict):
-        return _compile_any_all(cps, conditions)
+        return _compile_any_all(cps, conditions, one)
     if isinstance(conditions, list):
         if conditions and all(isinstance(c, dict) and
                               ('any' in c or 'all' in c)
                               for c in conditions):
-            return BoolExpr.all([_compile_any_all(cps, c)
+            return BoolExpr.all([_compile_any_all(cps, c, one)
                                  for c in conditions])
         if not conditions:
             raise CompileError('empty legacy condition list')
-        return BoolExpr.all([_compile_condition(cps, c)
-                             for c in conditions])
+        return BoolExpr.all([one(c) for c in conditions])
     raise CompileError('bad conditions shape')
 
 
-def _compile_any_all(cps: CompiledPolicySet, block: dict) -> BoolExpr:
+def _compile_any_all(cps: CompiledPolicySet, block: dict, one) -> BoolExpr:
     parts: List[BoolExpr] = []
     any_conditions = block.get('any')
     all_conditions = block.get('all')
@@ -537,13 +554,11 @@ def _compile_any_all(cps: CompiledPolicySet, block: dict) -> BoolExpr:
             parts.append(BoolExpr.negate(
                 BoolExpr.of(Leaf(Slot(()), 'true'))))
         else:
-            parts.append(BoolExpr.any(
-                [_compile_condition(cps, c) for c in any_conditions]))
+            parts.append(BoolExpr.any([one(c) for c in any_conditions]))
     if all_conditions:
         if not isinstance(all_conditions, list):
             raise CompileError('bad all block')
-        parts.append(BoolExpr.all(
-            [_compile_condition(cps, c) for c in all_conditions]))
+        parts.append(BoolExpr.all([one(c) for c in all_conditions]))
     if not parts:
         return BoolExpr.of(Leaf(Slot(()), 'true'))
     return BoolExpr.all(parts)
@@ -616,3 +631,125 @@ def _compile_condition_key(key: Any) -> Tuple[GatherSlot, bool]:
     except Exception as e:  # noqa: BLE001 - parser errors → host
         raise CompileError(f'unparseable condition key: {e}')
     return GatherSlot(expr), True
+
+
+# ---------------------------------------------------------------------------
+# foreach compilation (deny-conditions form)
+
+def _compile_foreach(cps: CompiledPolicySet, entries: Any) -> StatusExpr:
+    """Compile ``validate.foreach`` into per-element condition programs
+    (engine.py:611 _validate_foreach, reference: pkg/engine/validation.go:319).
+
+    Supported entry shape: ``list`` + ``deny`` (+ element-scoped
+    ``preconditions``); context entries, nested foreach, pattern forms,
+    and explicit elementScope fall back to the host."""
+    from .ir import ElemGather, ForEachEntryIR
+    if not isinstance(entries, list) or not entries:
+        raise CompileError('foreach must be a non-empty list')
+    ir_entries: List[ForEachEntryIR] = []
+    for entry in entries:
+        if not isinstance(entry, dict):
+            raise CompileError('bad foreach entry')
+        if entry.get('context'):
+            raise CompileError('foreach context entries not vectorized')
+        for k in ('foreach', 'pattern', 'anyPattern', 'podSecurity'):
+            if entry.get(k) is not None:
+                raise CompileError(f'foreach {k} not vectorized')
+        if entry.get('elementScope'):
+            raise CompileError('explicit elementScope not vectorized')
+        if entry.get('deny') is None:
+            raise CompileError('foreach entry without deny')
+        list_expr = entry.get('list') or ''
+        if not isinstance(list_expr, str) or not list_expr.strip():
+            raise CompileError('foreach entry without list')
+        list_expr = list_expr.strip()
+        if _STATEFUL_FN_RE.search(list_expr):
+            raise CompileError('stateful function in foreach list')
+        from ..engine.jmespath import compile as jp_compile
+        try:
+            jp_compile(list_expr)
+        except Exception as e:  # noqa: BLE001
+            raise CompileError(f'unparseable foreach list: {e}')
+        list_gather = GatherSlot(list_expr)
+        cps.gather_id(list_gather)
+
+        err_gathers: List[ElemGather] = []
+        precond = None
+        if entry.get('preconditions') is not None:
+            precond = _compile_conditions(
+                cps, entry['preconditions'],
+                elem_list_expr=list_expr, err_gathers=err_gathers)
+        deny = _compile_conditions(
+            cps, (entry['deny'] or {}).get('conditions'),
+            elem_list_expr=list_expr, err_gathers=err_gathers)
+        ir_entries.append(ForEachEntryIR(
+            list_gather=list_gather, precond=precond, deny=deny,
+            err_gathers=tuple(err_gathers)))
+    return StatusExpr('foreach', operand=tuple(ir_entries))
+
+
+def _compile_condition_elem(cps: CompiledPolicySet, list_expr: str,
+                            cond: dict, err_gathers: List) -> BoolExpr:
+    """Compile one foreach condition: either side may be an element-scoped
+    variable (exactly one side; both-constant folds at compile time)."""
+    from ..engine import operators as host_ops
+    from .ir import ElemGather
+    op = str(cond.get('operator', '')).lower()
+    key = cond.get('key')
+    value = cond.get('value')
+    key_var = isinstance(key, str) and \
+        _SINGLE_VAR_RE.match(key.strip()) is not None
+    value_var = isinstance(value, str) and \
+        _SINGLE_VAR_RE.match(value.strip()) is not None
+
+    def elem_gather(expr_str: str) -> 'ElemGather':
+        m = _SINGLE_VAR_RE.match(expr_str.strip())
+        expr = m.group(1).strip()
+        if '{{' in expr:
+            raise CompileError('nested variables not vectorized')
+        if _STATEFUL_FN_RE.search(expr):
+            raise CompileError('stateful function in condition')
+        from ..engine.jmespath import compile as jp_compile
+        try:
+            jp_compile(expr)
+        except Exception as e:  # noqa: BLE001
+            raise CompileError(f'unparseable condition expr: {e}')
+        eg = ElemGather(list_expr, expr)
+        cps.elem_gather_id(eg)
+        err_gathers.append(eg)
+        return eg
+
+    if key_var and not value_var:
+        if op not in _SUPPORTED_COND_OPS:
+            raise CompileError(f'operator {op!r} not vectorized')
+        _check_constant(value)
+        return BoolExpr.of_cond(CondCheck(
+            gather=elem_gather(key), op=op, values=_normalize_values(value),
+            list_value=isinstance(value, list)))
+    if value_var and not key_var:
+        if op not in ('equal', 'equals', 'notequal', 'notequals',
+                      'anyin', 'allin', 'anynotin', 'allnotin'):
+            raise CompileError(f'operator {op!r} not vectorized for '
+                               'variable values')
+        if isinstance(key, str) and (is_variable(key) or is_reference(key)):
+            raise CompileError('partial-variable key not vectorized')
+        if isinstance(key, (list, dict)):
+            raise CompileError('non-scalar key with variable value not '
+                               'vectorized')
+        _check_constant(key)
+        return BoolExpr.of_cond(CondCheck(
+            gather=None, op=op, key_const=key,
+            value_gather=elem_gather(value)))
+    if not key_var and not value_var:
+        # both sides constant: fold through the host operators
+        if isinstance(key, str) and (is_variable(key) or is_reference(key)):
+            raise CompileError('partial-variable key not vectorized')
+        _check_constant(key)
+        _check_constant(value)
+        handler = host_ops._HANDLERS.get(op)
+        if handler is None:
+            raise CompileError(f'unknown operator {op!r}')
+        result = handler(key, value)
+        const = BoolExpr.of(Leaf(Slot(()), 'true'))
+        return const if result else BoolExpr.negate(const)
+    raise CompileError('variables on both condition sides not vectorized')

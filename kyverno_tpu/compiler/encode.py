@@ -391,10 +391,40 @@ def _cond_needs(check) -> LaneNeeds:
     return n
 
 
+def _cond_b_needs(check) -> LaneNeeds:
+    """Value-gather lanes read by a mode-B check (const key vs gather
+    value; ops/eval.py _cond_b_tf)."""
+    n = LaneNeeds()
+    key = check.key_const
+    op = check.op
+    if op in ('equal', 'equals', 'notequal', 'notequals'):
+        if isinstance(key, bool):
+            n.milli = True
+        elif isinstance(key, (int, float)):
+            n.milli = True
+        elif isinstance(key, str):
+            n.milli = True
+            n.nanos = True
+            n.lit_zero = True
+            n.length = True
+            n.wild = True
+            n.head = max(n.head, _blen(key))
+    else:  # in-family with scalar const key
+        ks = key if isinstance(key, str) else _sprint(key)
+        n.length = True
+        n.wild = True
+        # full head window: the scalar-value range/JSON suspicion scan
+        # needs to see every byte of the value string
+        n.head = STR_LEN
+        n.add_pattern(ks)
+    return n
+
+
 def _analyze_needs(cps: CompiledPolicySet):
     slot_needs: Dict[Slot, LaneNeeds] = {s: LaneNeeds() for s in cps.slots}
     gather_needs: Dict[GatherSlot, LaneNeeds] = \
         {g: LaneNeeds() for g in cps.gathers}
+    elem_needs: Dict = {g: LaneNeeds() for g in cps.elem_gathers}
     array_paths: set = set()
 
     def visit_bool(expr):
@@ -408,9 +438,16 @@ def _analyze_needs(cps: CompiledPolicySet):
             n.merge(_leaf_needs(leaf.op, leaf.operand))
             return
         if expr.kind == 'cond':
-            g = expr.cond.gather
-            n = gather_needs.setdefault(g, LaneNeeds())
-            n.merge(_cond_needs(expr.cond))
+            check = expr.cond
+            if check.value_gather is not None:
+                n = elem_needs.setdefault(check.value_gather, LaneNeeds())
+                n.merge(_cond_b_needs(check))
+                return
+            from .ir import ElemGather
+            table = elem_needs if isinstance(check.gather, ElemGather) \
+                else gather_needs
+            n = table.setdefault(check.gather, LaneNeeds())
+            n.merge(_cond_needs(check))
             return
         if expr.kind in ('any_elem', 'all_elem') and expr.slot is not None:
             array_paths.add(expr.slot.path)
@@ -421,6 +458,11 @@ def _analyze_needs(cps: CompiledPolicySet):
         if node is None:
             return
         visit_bool(node.expr)
+        if node.kind == 'foreach':
+            for entry in node.operand or ():
+                if entry.precond is not None:
+                    visit_bool(entry.precond)
+                visit_bool(entry.deny)
         if node.kind in ('forall', 'exists', 'scalars') and \
                 node.slot is not None:
             array_paths.add(node.slot.path)
@@ -452,7 +494,7 @@ def _analyze_needs(cps: CompiledPolicySet):
                 visit_guards(c)
         visit_guards(prog.status)
     # deterministic order shared by the encoder and the evaluator
-    return slot_needs, gather_needs, sorted(array_paths)
+    return slot_needs, gather_needs, elem_needs, sorted(array_paths)
 
 
 # ---------------------------------------------------------------------------
@@ -476,6 +518,8 @@ class Batch:
         self.array_meta: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
         self.gather_lanes: Dict[GatherSlot, Lanes] = {}
         self.gather_meta: Dict[GatherSlot, Dict[str, np.ndarray]] = {}
+        self.elem_lanes: Dict[Any, Lanes] = {}
+        self.elem_meta: Dict[Any, Dict[str, np.ndarray]] = {}
 
     def tensors(self) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
@@ -492,6 +536,13 @@ class Batch:
             out[f'g{k}_count'] = meta['count']
             out[f'g{k}_overflow'] = meta['overflow']
             out[f'g{k}_notfound'] = meta['notfound']
+        for k, (g, lanes) in enumerate(self.elem_lanes.items()):
+            out.update(lanes.tensors(f'e{k}'))
+            meta = self.elem_meta[g]
+            out[f'e{k}_kind'] = meta['kind']
+            out[f'e{k}_count'] = meta['count']
+            out[f'e{k}_overflow'] = meta['overflow']
+            out[f'e{k}_notfound'] = meta['notfound']
         return out
 
 
@@ -541,7 +592,7 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  padded_n: int = 0) -> Batch:
     n = max(len(resources), padded_n)
     batch = Batch(n)
-    slot_needs, gather_needs, array_paths = _needs_cached(cps)
+    slot_needs, gather_needs, elem_needs, array_paths = _needs_cached(cps)
 
     # element width: sized to the longest observed list (pow-2 clamped) —
     # real batches rarely approach MAX_ELEMS, and the element axis
@@ -562,6 +613,41 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                 longest_g = max(longest_g, len(value))
     gwidth = _pow2_clamp(longest_g, 4, MAX_GATHER)
     batch.gather_width = gwidth
+
+    # foreach element gathers: evaluate each expr per element of its list
+    # (reusing the list gather's results) under the element context the
+    # host injects (engine/context.py:109 add_element)
+    elem_results: Dict[Any, List[List[Tuple[str, Any]]]] = {}
+    longest_eg = 1
+    for eg in cps.elem_gathers:
+        searcher = _gather_searcher(GatherSlot(eg.expr))
+        lres = gather_results.get(GatherSlot(eg.list_expr))
+        per_resource: List[List[Tuple[str, Any]]] = []
+        for r, doc in enumerate(resources):
+            marker, value = lres[r]
+            if marker == 'list':
+                elements = value
+            elif marker == 'scalar':
+                elements = [value]
+            else:
+                per_resource.append([])
+                continue
+            row: List[Tuple[str, Any]] = []
+            for fe, elem in enumerate(elements[:gwidth]):
+                if elem is None:
+                    row.append(('null', None))
+                    continue
+                ctx = {'request': {'object': doc}, 'element': elem,
+                       'element0': elem, 'elementIndex': fe,
+                       'elementIndex0': fe}
+                m2, v2 = _run_gather_ctx(searcher, ctx)
+                if m2 == 'list':
+                    longest_eg = max(longest_eg, len(v2))
+                row.append((m2, v2))
+            per_resource.append(row)
+        elem_results[eg] = per_resource
+    egwidth = _pow2_clamp(longest_eg, 4, MAX_GATHER)
+    batch.elem_gather_width = egwidth
 
     # array metadata channels (count/overflow/tag) for forall/exists nodes
     for path in array_paths:
@@ -586,6 +672,15 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
             'notfound': np.zeros(n, bool),
         }
 
+    for eg in cps.elem_gathers:
+        batch.elem_lanes[eg] = Lanes((n, gwidth, egwidth), elem_needs[eg])
+        batch.elem_meta[eg] = {
+            'kind': np.zeros((n, gwidth), np.int8),
+            'count': np.zeros((n, gwidth), np.int32),
+            'overflow': np.zeros((n, gwidth), bool),
+            'notfound': np.zeros((n, gwidth), bool),
+        }
+
     slot_plan = _slot_plan(cps, batch)
     for r, doc in enumerate(resources):
         _encode_doc(r, doc, slot_plan, batch, elems)
@@ -594,6 +689,14 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
         results = gather_results[g]
         for r, (marker, value) in enumerate(results):
             _fill_gather(r, marker, value, lanes, meta, gwidth)
+    for eg in cps.elem_gathers:
+        lanes, meta = batch.elem_lanes[eg], batch.elem_meta[eg]
+        rows = elem_results[eg]
+        for r, row in enumerate(rows):
+            for fe, (marker, value) in enumerate(row):
+                if marker == 'null':
+                    continue  # null foreach elements are skipped entirely
+                _fill_gather((r, fe), marker, value, lanes, meta, egwidth)
     return batch
 
 
@@ -707,9 +810,13 @@ def _gather_searcher(g: GatherSlot):
 
 def _run_gather(searcher, doc: dict):
     """Evaluate one gather projection; returns a (marker, value) pair."""
+    return _run_gather_ctx(searcher, {'request': {'object': doc}})
+
+
+def _run_gather_ctx(searcher, ctx: dict):
     from ..engine.jmespath import NotFoundError
     try:
-        result = searcher.search({'request': {'object': doc}})
+        result = searcher.search(ctx)
     except NotFoundError:
         # missing path → the host's deterministic substitution-error ERROR
         # (engine.py:388; synthesized on device via STATUS_VAR_ERR)
@@ -723,24 +830,27 @@ def _run_gather(searcher, doc: dict):
     return 'scalar', result
 
 
-def _fill_gather(r: int, marker: str, value, lanes: Lanes, meta,
+def _fill_gather(r, marker: str, value, lanes: Lanes, meta,
                  gwidth: int) -> None:
+    """Fill one gather row; ``r`` is an int (plain gathers) or an
+    (r, fe) tuple (per-foreach-element gathers)."""
+    idx = r if isinstance(r, tuple) else (r,)
     if marker == 'notfound':
-        meta['notfound'][r] = True
+        meta['notfound'][idx] = True
         return
     if marker == 'raised':
-        meta['overflow'][r] = True
+        meta['overflow'][idx] = True
         return
     if marker == 'null':
         return
     if marker == 'list':
-        meta['kind'][r] = 2
-        meta['count'][r] = min(len(value), gwidth)
+        meta['kind'][idx] = 2
+        meta['count'][idx] = min(len(value), gwidth)
         if len(value) > gwidth:
-            meta['overflow'][r] = True
+            meta['overflow'][idx] = True
         for e, v in enumerate(value[:gwidth]):
-            lanes.encode((r, e), v, sprint_form=True)
+            lanes.encode(idx + (e,), v, sprint_form=True)
         return
-    meta['kind'][r] = 1
-    meta['count'][r] = 1
-    lanes.encode((r, 0), value, sprint_form=True)
+    meta['kind'][idx] = 1
+    meta['count'][idx] = 1
+    lanes.encode(idx + (0,), value, sprint_form=True)

@@ -89,18 +89,31 @@ class Slot:
 class GatherSlot:
     """A scalar-or-list value gathered from the resource document.
 
-    ``expr`` is the raw JMESPath condition key (braces stripped).  The
-    compiler only admits shapes whose semantics the encoder can represent
-    (field chains over ``request.object``, ``[]`` flatten projections,
-    field multiselect lists, ``keys(@)``, ``|| <literal>``); at encode
-    time the expression is evaluated verbatim by the in-repo JMESPath
-    interpreter against ``{'request': {'object': doc}}``, so gather
-    semantics are host-exact by construction.
+    ``expr`` is the raw JMESPath condition key (braces stripped); at
+    encode time it is evaluated verbatim by the in-repo JMESPath
+    interpreter against the same ``{'request': {'object': doc}}`` context
+    the host engine builds, so gather semantics are host-exact by
+    construction.  ``__pss:``-prefixed exprs are encoder-side Python
+    projections (pss_compile.virtual_searcher).
     """
     expr: str
 
     def __str__(self):
         return self.expr
+
+
+@dataclass(frozen=True)
+class ElemGather:
+    """A per-foreach-element projection: ``expr`` evaluated against the
+    element context (``element`` / ``elementIndex`` injected over the
+    request, engine/context.py:109 add_element) for each element of the
+    ``list_expr`` foreach list.  Lanes are [R, FE, EG] with per-(r, fe)
+    kind/count/overflow/notfound metadata."""
+    list_expr: str
+    expr: str
+
+    def __str__(self):
+        return f'{self.list_expr}[]→{self.expr}'
 
 
 # --- leaf checks ------------------------------------------------------------
@@ -179,21 +192,24 @@ class Leaf:
 
 @dataclass(frozen=True)
 class CondCheck:
-    """One compiled deny/precondition condition over a gather slot.
+    """One compiled deny/precondition condition.
 
-    ``op`` is the lower-cased reference operator name; ``values`` is the
-    constant operand list (scalars normalized to their Go string form at
-    compile time where applicable). Semantics mirror
-    kyverno_tpu/engine/operators.py (reference:
-    pkg/engine/variables/operator/*.go).
+    Two modes (semantics: kyverno_tpu/engine/operators.py, reference:
+    pkg/engine/variables/operator/*.go):
+      A — ``gather`` key vs constant ``values`` (the common shape);
+      B — constant ``key_const`` vs a ``value_gather`` projection
+          (foreach conditions like ``key: ALL, value: {{element...}}``).
+    ``op`` is the lower-cased reference operator name.  ``list_value``
+    records whether the constant side was a YAML list — the reference
+    dispatches on the operand's type, not just its contents.
     """
-    gather: GatherSlot
+    gather: Optional[Any]        # GatherSlot | ElemGather (mode A key)
     op: str                      # 'anyin' | 'allin' | 'anynotin' | 'allnotin'
                                  # | 'equals' | 'notequals' | numeric cmps
-    values: Tuple[Any, ...]
-    # True when the condition value was a YAML list (vs a bare scalar) —
-    # the reference dispatches on the value's type, not just its contents
+    values: Tuple[Any, ...] = ()
     list_value: bool = False
+    key_const: Any = None        # mode B constant key
+    value_gather: Optional[Any] = None  # mode B value projection
 
 
 @dataclass(frozen=True)
@@ -317,9 +333,25 @@ class RuleProgram:
     # (level, version) for podSecurity rules — synthesized PASS responses
     # carry {'level', 'version', 'checks': []} (engine.py:592-605)
     pss: Optional[Tuple[str, str]] = None
+    # static skip message when the rule's SKIP outcome is synthesizable
+    # (foreach 'rule skipped', engine.py:628)
+    skip_message: Optional[str] = None
     background: bool = True
     # the original rule dict (for host-side match evaluation + fallback)
     rule_raw: Optional[dict] = None
+
+
+@dataclass(frozen=True)
+class ForEachEntryIR:
+    """One compiled ``validate.foreach`` entry (deny-conditions form).
+
+    ``err_gathers`` lists the entry's element gathers in substitution
+    order (preconditions doc first, then deny conditions) for the
+    per-element variable-error semantics (engine.py:660-667)."""
+    list_gather: GatherSlot
+    precond: Optional[BoolExpr]
+    deny: Optional[BoolExpr]
+    err_gathers: Tuple[ElemGather, ...] = ()
 
 
 @dataclass
@@ -329,6 +361,8 @@ class CompiledPolicySet:
     slot_index: Dict[Slot, int] = field(default_factory=dict)
     gathers: List[GatherSlot] = field(default_factory=list)
     gather_index: Dict[GatherSlot, int] = field(default_factory=dict)
+    elem_gathers: List[ElemGather] = field(default_factory=list)
+    elem_gather_index: Dict[ElemGather, int] = field(default_factory=dict)
     programs: List[RuleProgram] = field(default_factory=list)
     # (policy_index, rule dict, policy) for rules the device cannot evaluate
     host_rules: List[Tuple[int, dict, Any]] = field(default_factory=list)
@@ -345,6 +379,12 @@ class CompiledPolicySet:
             self.gather_index[g] = len(self.gathers)
             self.gathers.append(g)
         return self.gather_index[g]
+
+    def elem_gather_id(self, g: ElemGather) -> int:
+        if g not in self.elem_gather_index:
+            self.elem_gather_index[g] = len(self.elem_gathers)
+            self.elem_gathers.append(g)
+        return self.elem_gather_index[g]
 
 
 class CompileError(Exception):

@@ -25,7 +25,6 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..pss.checks import (_ALLOWED_SELINUX_TYPES, _ALLOWED_SYSCTLS,
-                          _ALLOWED_VOLUME_TYPES, _APPARMOR_PREFIX,
                           _BASELINE_CAPS, LEVEL_BASELINE)
 from .ir import (BoolExpr, CompileError, CompiledPolicySet, CondCheck,
                  GatherSlot, Leaf, Slot, StatusExpr)
@@ -308,24 +307,15 @@ class _VirtualSearcher:
 
 
 def _apparmor_violation(pod: dict) -> bool:
-    meta = pod.get('metadata') or {}
-    for k, v in (meta.get('annotations') or {}).items():
-        if k.startswith(_APPARMOR_PREFIX):
-            if v not in ('runtime/default', '') and \
-                    not str(v).startswith('localhost/'):
-                return True
-    return False
+    from ..pss.checks import check_app_armor
+    return not check_app_armor(pod.get('metadata') or {},
+                               pod.get('spec') or {}).allowed
 
 
 def _volumes_violation(pod: dict) -> bool:
-    spec = pod.get('spec') or {}
-    for v in spec.get('volumes') or []:
-        if not isinstance(v, dict):
-            continue
-        for key in v:
-            if key != 'name' and key not in _ALLOWED_VOLUME_TYPES:
-                return True
-    return False
+    from ..pss.checks import check_restricted_volumes
+    return not check_restricted_volumes(pod.get('metadata') or {},
+                                        pod.get('spec') or {}).allowed
 
 
 _VIRTUALS = {'apparmor': _apparmor_violation, 'volumes': _volumes_violation}

@@ -232,6 +232,10 @@ class BatchScanner:
                     rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
                                       prog.error_messages[int(detail[i, j])],
                                       RuleStatus.ERROR)
+                elif st == STATUS_SKIP and prog.skip_message is not None:
+                    # foreach 'rule skipped' is a static message
+                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                      prog.skip_message, RuleStatus.SKIP)
                 else:
                     # FAIL / anchor-SKIP / HOST: re-run this rule on the
                     # host for the exact status + message

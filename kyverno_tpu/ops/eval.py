@@ -141,15 +141,22 @@ class _View:
     """Accessor for one lane bundle (slot or gather elements) in the flat
     tensor dict, plus tag predicates shared by all ops."""
 
+    _BYTE_LANES = frozenset({'str_head', 'str_tail'})
+
     def __init__(self, t: Dict[str, Any], prefix: str, elem: int = None):
         self._t = t
         self._p = prefix
-        self._elem = elem  # gather element index (axis 1), or None
+        # gather element index — the LAST gather axis, so the same view
+        # works for [R, G] gathers and [R, FE, EG] per-foreach gathers
+        self._elem = elem
 
     def lane(self, name: str):
         arr = self._t[f'{self._p}_{name}']
         if self._elem is not None:
-            arr = arr[:, self._elem]
+            if name in self._BYTE_LANES:
+                arr = arr[..., self._elem, :]
+            else:
+                arr = arr[..., self._elem]
         return arr
 
     def has(self, name: str) -> bool:
@@ -912,6 +919,166 @@ def _is_semverish(v: str) -> bool:
     return _try_semver(v) is not None
 
 
+def _suspicious_scalar(view: _View) -> Any:
+    """Scalar string values that might trigger the host's runtime range
+    or JSON handling (contains '-', starts with '[', has wildcards, or
+    exceeds the head window) — undecidable beyond plain equality."""
+    head = view.lane('str_head')
+    w = head.shape[-1]
+    pos_valid = jnp.arange(w) < jnp.minimum(view.str_len, w)[..., None]
+    has_dash = jnp.any((head == ord('-')) & pos_valid, axis=-1)
+    starts_bracket = head[..., 0] == ord('[')
+    hw = view.lane('has_wild') if view.has('has_wild') else \
+        jnp.zeros(view.tag.shape, bool)
+    return has_dash | starts_bracket | hw | (view.str_len > w)
+
+
+def _cond_b_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
+    """Mode-B checks: constant key vs gathered value (foreach conditions
+    like ``key: ALL, value: {{element...drop[]}}``; operators.py with the
+    runtime side on the right)."""
+    op = check.op
+    key = check.key_const
+    kind = t[f'{prefix}_kind']
+    count = t[f'{prefix}_count']
+    overflow = t[f'{prefix}_overflow']
+    notfound = t[f'{prefix}_notfound']
+    shape = kind.shape
+    sv = _View(t, prefix, 0)
+    ev = _View(t, prefix)
+    zeros = jnp.zeros(shape, bool)
+
+    if op in ('equal', 'equals', 'notequal', 'notequals'):
+        res = _b_equals(t, prefix, key, sv, kind, count, overflow)
+        if op in ('notequal', 'notequals'):
+            res = res.negate()
+    else:  # anyin / allin / anynotin / allnotin with a scalar const key
+        negate = op in ('anynotin', 'allnotin')
+        if key is None or isinstance(key, bool):
+            # host: key not str/num/list → False for every variant
+            res = _K(zeros, jnp.ones(shape, bool))
+        else:
+            ks = key if isinstance(key, str) else _sprint(key)
+            # value list: ∃ element matching either direction
+            # (_key_in_array(K, value) — the key is scalar, so every op
+            # reduces to one membership test; operators.py:299-369)
+            m_eq = ev.eq_const(ks)
+            m_pat = ev.match_const_pattern(ks)
+            hw = ev.lane('has_wild') if ev.has('has_wild') else None
+            et = m_eq.t | m_pat.t
+            ef = m_eq.f & m_pat.f
+            if hw is not None:
+                ef = ef & ~hw  # wildcard elements may match as patterns
+            gw = ev.lane('tag').shape[-1]
+            valid = jnp.arange(gw) < count[..., None]
+            lt = jnp.any(valid & et, axis=-1)
+            lf = jnp.all(~valid | ef, axis=-1) & ~overflow
+            # value scalar string: match(value, K) → equality unless the
+            # value could be a wildcard/range/JSON form at runtime
+            s_eq = sv.eq_const(ks)
+            s_susp = _suspicious_scalar(sv)
+            st_ = (sv.tag == TAG_STRING) & s_eq.t
+            sf_ = (sv.tag == TAG_STRING) & s_eq.f & ~s_susp
+            scalar_str = (kind == 1) & (sv.tag == TAG_STRING)
+            scalar_other = (kind == 1) & (sv.tag != TAG_STRING)
+            r_t = ((kind == 2) & lt) | (scalar_str & st_)
+            r_f = ((kind == 2) & lf) | (scalar_str & sf_) | \
+                scalar_other | (kind == 0)
+            res = _K(r_t, r_f & ~r_t)
+            if negate:
+                # r=None (invalid value types) stays False, not True
+                inv = scalar_other | (kind == 0)
+                res = _K(res.f & ~inv, (res.t | inv) & ~(res.f & ~inv))
+    bad = notfound | ((kind == 0) & overflow)
+    return _K(res.t & ~bad, res.f & ~bad)
+
+
+def _b_equals(t, prefix: str, key, sv: _View, kind, count, overflow) -> _K:
+    """operators._equal(const_key, gathered_value)."""
+    shape = kind.shape
+    zeros = jnp.zeros(shape, bool)
+    scalar = kind == 1
+    if isinstance(key, bool):
+        tv = scalar & (sv.tag == TAG_BOOL) & ((sv.milli != 0) == key)
+        return _K(tv, ~tv)
+    if isinstance(key, (int, float)):
+        # value num → exact numeric equality; value str → float compare
+        kf = Fraction(str(key)) * 1000
+        if kf.denominator == 1 and abs(kf) <= _I64_MAX:
+            num_t = sv.numish & sv.lane('milli_ok') & (sv.milli == int(kf))
+        else:
+            num_t = zeros  # out of the milli lane → never equal exactly
+        mok53 = sv.lane('milli_ok') & (jnp.abs(sv.milli) <= (1 << 53))
+        key_f = sv.milli.astype(jnp.float64) / 1000.0
+        str_t = (sv.tag == TAG_STRING) & sv.lane('str_is_float') & mok53 & \
+            (key_f == jnp.float64(float(key)))
+        str_u = (sv.tag == TAG_STRING) & sv.lane('str_is_float') & ~mok53
+        num_u = sv.numish & ~sv.lane('milli_ok')
+        tv = scalar & (num_t | str_t)
+        uv = scalar & (num_u | str_u)
+        return _K(tv, ~tv & ~uv)
+    if isinstance(key, str):
+        is_str = sv.tag == TAG_STRING
+        try:
+            kd = parse_duration(key) if key != '0' else None
+        except (ValueError, TypeError):
+            kd = None
+        if kd is not None:
+            # duration pair: value duration-string or numeric
+            v_dur = is_str & sv.lane('str_is_dur') & ~sv.is_zero_str
+            if abs(kd) <= _I64_MAX:
+                dur_t = v_dur & sv.lane('nanos_ok') & (sv.nanos == kd)
+                dur_u = v_dur & ~sv.lane('nanos_ok')
+                mok53 = sv.lane('milli_ok') & \
+                    (jnp.abs(sv.milli) <= (1 << 53))
+                key_f = sv.milli.astype(jnp.float64) / 1000.0
+                vd = jnp.trunc(key_f * 1e9)
+                num_t = sv.numish & mok53 & (vd == jnp.float64(kd))
+                num_u = sv.numish & ~mok53
+            else:
+                # constant beyond the nanos lane: duration-pair outcomes
+                # are undecidable on device
+                dur_t = num_t = zeros
+                dur_u = v_dur
+                num_u = sv.numish
+            decided = v_dur | sv.numish
+            rest = is_str & ~v_dur
+        else:
+            dur_t = dur_u = num_t = num_u = zeros
+            decided = zeros
+            rest = is_str
+        try:
+            kq = Quantity.parse(key)
+        except ValueError:
+            kq = None
+        if kq is not None:
+            m = kq.value * 1000
+            if m.denominator == 1 and abs(m.numerator) <= _I64_MAX:
+                qty_t = rest & sv.lane('str_is_qty') & \
+                    sv.lane('milli_ok') & (sv.milli == int(m))
+            else:
+                qty_t = zeros
+            qty_u = rest & sv.lane('str_is_qty') & ~sv.lane('milli_ok')
+            # a quantity-keyed compare is decided for every string value
+            qty_f_zone = rest
+            wild_zone = zeros
+        else:
+            qty_t = qty_u = zeros
+            qty_f_zone = zeros
+            wild_zone = rest
+        # wildcard: match(value_as_pattern, K) — equality unless wild
+        w_eq = sv.eq_const(key)
+        hw = sv.lane('has_wild') if sv.has('has_wild') else zeros
+        wild_t = wild_zone & w_eq.t
+        wild_u = wild_zone & ~w_eq.t & hw
+        tv = scalar & (dur_t | num_t | qty_t | wild_t)
+        uv = scalar & (dur_u | num_u | qty_u | wild_u)
+        return _K(tv, ~tv & ~uv)
+    # None / list / dict const keys: _equal returns False for gathered
+    # scalars; list-vs-list is not compiled in mode B
+    return _K(zeros, jnp.ones(shape, bool))
+
+
 def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
     op = check.op
     kind = t[f'{prefix}_kind']
@@ -957,8 +1124,14 @@ def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
 def build_evaluator(cps: CompiledPolicySet):
     slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
     gather_prefix = {g: f'g{k}' for k, g in enumerate(cps.gathers)}
-    _, _, array_paths = _needs_cached(cps)
+    elem_prefix = {g: f'e{k}' for k, g in enumerate(cps.elem_gathers)}
+    _, _, _, array_paths = _needs_cached(cps)
     array_prefix = {path: f'a{j}' for j, path in enumerate(array_paths)}
+
+    def check_prefix(check: CondCheck) -> str:
+        if check.value_gather is not None:
+            return elem_prefix[check.value_gather]
+        return elem_prefix.get(check.gather) or gather_prefix[check.gather]
 
     dims: Dict[str, int] = {}
 
@@ -1026,9 +1199,12 @@ def build_evaluator(cps: CompiledPolicySet):
             if check in cond_cache:
                 out = cond_cache[check]
             else:
-                out = cond_tf(t, gather_prefix[check.gather], check)
+                if check.value_gather is not None:
+                    out = _cond_b_tf(t, check_prefix(check), check)
+                else:
+                    out = cond_tf(t, check_prefix(check), check)
                 cond_cache[check] = out
-            if depth > 0:
+            if depth > 0 and out.t.ndim == 1:
                 out = _K(broadcast(out.t, depth), broadcast(out.f, depth))
             return out
         if expr.kind in ('any_elem', 'all_elem'):
@@ -1050,6 +1226,14 @@ def build_evaluator(cps: CompiledPolicySet):
                 ff = jnp.any(valid & sub.f, axis=-1)
             return _K(known_arr & tt, known_arr & ff)
         parts = [eval_expr(t, c, depth) for c in expr.children]
+        nd = max(p.t.ndim for p in parts)
+        if any(p.t.ndim != nd for p in parts):
+            # scalar parts (const-folded conditions) broadcast against
+            # element-scoped [R, FE] parts via trailing axes
+            parts = [p if p.t.ndim == nd else
+                     _K(p.t.reshape(p.t.shape + (1,) * (nd - p.t.ndim)),
+                        p.f.reshape(p.f.shape + (1,) * (nd - p.f.ndim)))
+                     for p in parts]
         if expr.kind == 'and':
             return _k_all(parts)
         if expr.kind == 'or':
@@ -1187,6 +1371,64 @@ def build_evaluator(cps: CompiledPolicySet):
                                               jnp.int8(SKIP),
                                               jnp.int8(PASS)))))
             return s.astype(jnp.int8), zd(s)
+        if kind == 'foreach':
+            # engine.py:611 _validate_foreach: entries in order; the
+            # first non-pass element outcome decides; zero applied
+            # elements overall → 'rule skipped'
+            n = t[next(iter(t))].shape[0]
+            nonpass = jnp.zeros(n, bool)
+            unknown = jnp.zeros(n, bool)
+            apply_any = jnp.zeros(n, bool)
+            for entry in node.operand:
+                lp = gather_prefix[entry.list_gather]
+                lkind = t[f'{lp}_kind']
+                lcount = t[f'{lp}_count']
+                lovf = t[f'{lp}_overflow']
+                # list query failures (NotFound / interpreter errors) skip
+                # the entry silently (engine.py:615-618) → kind 0
+                active = lkind != 0
+                lview = _View(t, lp)
+                gw = lview.tag.shape[-1]
+                valid = (jnp.arange(gw) < lcount[:, None]) & \
+                    (lview.tag != TAG_NULL)  # null elements are skipped
+                # element variable errors (first missing var → ERROR elem)
+                elem_err = jnp.zeros((n, gw), bool)
+                for eg in entry.err_gathers:
+                    elem_err = elem_err | t[f'{elem_prefix[eg]}_notfound']
+                def at_elem(k: _K) -> _K:
+                    if k.t.ndim == 1:  # fully const-folded conditions
+                        return _K(k.t[:, None], k.f[:, None])
+                    return k
+                if entry.precond is not None:
+                    pre = at_elem(eval_expr(t, entry.precond, 0))
+                else:
+                    pre = _K.const((n, gw), True)
+                deny = at_elem(eval_expr(t, entry.deny, 0))
+                e_fail = ~elem_err & pre.t & deny.t
+                e_pass = ~elem_err & pre.t & deny.f
+                e_unknown = ~elem_err & (pre.unknown() |
+                                         (pre.t & deny.unknown()))
+                any_fail = jnp.any(valid & e_fail, axis=-1)
+                # an ERROR element returns only at the true last index
+                # (engine.py:663-665); overflow hides the true length
+                last_err = jnp.take_along_axis(
+                    elem_err & valid,
+                    jnp.maximum(lcount - 1, 0)[:, None],
+                    axis=-1)[..., 0] & ~lovf
+                entry_nonpass = active & (any_fail | last_err)
+                entry_unknown = active & (
+                    jnp.any(valid & e_unknown, axis=-1) | lovf) & \
+                    ~entry_nonpass
+                entry_apply = active & jnp.any(valid & e_pass, axis=-1)
+                nonpass = nonpass | entry_nonpass
+                unknown = unknown | entry_unknown
+                apply_any = apply_any | entry_apply
+            s = jnp.where(
+                nonpass, jnp.int8(FAIL),
+                jnp.where(unknown, jnp.int8(HOST),
+                          jnp.where(apply_any, jnp.int8(PASS),
+                                    jnp.int8(SKIP)))).astype(jnp.int8)
+            return s, jnp.zeros(n, jnp.int8)
         if kind == 'trackfail':
             sub_s, sub_d = eval_status(t, node.sub, depth)
             guard = eval_expr(t, node.expr, depth)

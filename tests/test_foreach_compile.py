@@ -1,0 +1,172 @@
+"""Device-vs-host equivalence for compiled foreach validate rules
+(compiler foreach + mode-B conditions vs engine.py _validate_foreach)."""
+
+import random
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+# the disallow-capabilities-strict shape from the reference restricted
+# chart (charts/kyverno-policies/templates/restricted)
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-drop-all
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: require-drop-all
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+        - key: "{{ request.operation || 'BACKGROUND' }}"
+          operator: NotEquals
+          value: DELETE
+      validate:
+        message: Containers must drop `ALL` capabilities.
+        foreach:
+          - list: request.object.spec.[ephemeralContainers, initContainers, containers][]
+            deny:
+              conditions:
+                all:
+                - key: ALL
+                  operator: AnyNotIn
+                  value: "{{ element.securityContext.capabilities.drop[] || `[]` }}"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: adding-capabilities-strict
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: adding-capabilities-strict
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: Any capabilities added other than NET_BIND_SERVICE are disallowed.
+        foreach:
+          - list: request.object.spec.[ephemeralContainers, initContainers, containers][]
+            deny:
+              conditions:
+                all:
+                - key: "{{ element.securityContext.capabilities.add[] || `[]` }}"
+                  operator: AnyNotIn
+                  value:
+                  - NET_BIND_SERVICE
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: foreach-precond
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: image-tags
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: named containers need tags
+        foreach:
+          - list: request.object.spec.containers
+            preconditions:
+              all:
+                - key: "{{ element.name }}"
+                  operator: NotEquals
+                  value: skipme
+            deny:
+              conditions:
+                any:
+                  - key: "{{ element.image }}"
+                    operator: Equals
+                    value: "*:latest"
+"""
+
+
+def load_pack():
+    return [Policy(d) for d in yaml.safe_load_all(PACK)]
+
+
+_CAPS = ['ALL', 'NET_ADMIN', 'KILL', 'NET_BIND_SERVICE', 'CHOWN']
+
+
+def make_pod(rng):
+    def container(i):
+        c = {'name': rng.choice([f'c{i}', 'skipme']),
+             'image': rng.choice(['nginx:latest', 'nginx:1.25', 'app',
+                                  'ghcr.io/a/b:latest'])}
+        if rng.random() < 0.7:
+            caps = {}
+            if rng.random() < 0.8:
+                caps['drop'] = rng.choice(
+                    [['ALL'], [], ['KILL'], ['ALL', 'KILL'], ['all'], None])
+            if rng.random() < 0.6:
+                caps['add'] = rng.sample(_CAPS, rng.randint(0, 2))
+            c['securityContext'] = {'capabilities': caps}
+        elif rng.random() < 0.3:
+            c['securityContext'] = {}
+        return c
+    spec = {'containers': [container(i)
+                           for i in range(rng.randint(1, 3))]}
+    if rng.random() < 0.3:
+        spec['initContainers'] = [container(9)]
+    if rng.random() < 0.2:
+        spec['ephemeralContainers'] = [container(8)]
+    if rng.random() < 0.05:
+        del spec['containers']
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': f'p{rng.randint(0, 999)}',
+                         'namespace': 'default'},
+            'spec': spec}
+
+
+class TestForEachCompile:
+    def test_pack_fully_compiles(self):
+        cps = compile_policies(load_pack())
+        assert cps.host_rules == [], \
+            [r.get('name') for _, r, _ in cps.host_rules]
+        assert len(cps.programs) == 3
+
+    def test_chart_restricted_strict_compiles(self):
+        import os
+        chart = '/root/reference/charts/kyverno-policies'
+        if not os.path.isdir(chart):
+            return
+        from kyverno_tpu.utils.helmlite import load_chart_policies
+        docs = load_chart_policies(chart, profiles=('restricted',))
+        strict = [Policy(d) for d in docs
+                  if d['metadata']['name'] == 'disallow-capabilities-strict']
+        assert strict
+        cps = compile_policies(strict)
+        assert cps.host_rules == [], \
+            [r.get('name') for _, r, _ in cps.host_rules]
+
+
+class TestForEachEquivalence:
+    def test_device_vs_host_fuzz(self):
+        policies = load_pack()
+        engine = Engine()
+        rng = random.Random(31)
+        resources = [make_pod(rng) for _ in range(150)]
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+        for resource, responses in zip(resources, scanned):
+            host = {}
+            for policy in policies:
+                resp = engine.apply_background_checks(
+                    PolicyContext(policy, new_resource=resource))
+                if resp.policy_response.rules:
+                    host[policy.name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            got = {}
+            for resp in responses:
+                if resp.policy_response.rules:
+                    got[resp.policy_response.policy_name] = {
+                        r.name: (r.status, r.message)
+                        for r in resp.policy_response.rules}
+            assert got == host, f'divergence on {resource}'
