@@ -208,6 +208,10 @@ def run_bench(n: int, platform: str) -> dict:
     host_s = time.time() - t2
     host_rate = host_dec / host_s if host_s > 0 else 0.0
 
+    # admission p50 latency through the full serving chain at ~1k policies
+    # (BASELINE metric: 'p50 webhook latency @1k policies')
+    lat_p50_ms, lat_n_policies = admission_latency(policies, resources)
+
     rate = decisions / scan_s if scan_s > 0 else 0.0
     return {
         'metric': 'bg_scan_decisions_per_sec_per_chip',
@@ -229,7 +233,54 @@ def run_bench(n: int, platform: str) -> dict:
         'host_engine_decisions_per_sec': round(host_rate, 1),
         'speedup_vs_host_engine': round(rate / host_rate, 2)
         if host_rate else None,
+        'admission_p50_ms': lat_p50_ms,
+        'admission_n_policies': lat_n_policies,
     }
+
+
+def admission_latency(policies, resources, target_policies=1000,
+                      samples=60):
+    """p50 latency of /validate through the full handler chain with the
+    pack replicated to ~1k policies (enforce mode)."""
+    import copy
+    import json as _json
+    import statistics
+    from kyverno_tpu.policycache.cache import Cache
+    from kyverno_tpu.api.policy import Policy
+    from kyverno_tpu.webhooks.handlers import ResourceHandlers
+    from kyverno_tpu.webhooks.server import WebhookServer
+
+    replicated = []
+    i = 0
+    while len(replicated) < target_policies:
+        for p in policies:
+            doc = copy.deepcopy(p.raw)
+            doc['metadata']['name'] = f"{doc['metadata']['name']}-r{i}"
+            doc.setdefault('spec', {})['validationFailureAction'] = 'Enforce'
+            replicated.append(Policy(doc))
+            if len(replicated) >= target_policies:
+                break
+        i += 1
+    cache = Cache()
+    cache.warm_up(replicated)
+    server = WebhookServer(ResourceHandlers(cache))
+    lat = []
+    for k in range(samples):
+        doc = resources[k % len(resources)]
+        review = _json.dumps({
+            'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+            'request': {
+                'uid': f'u{k}', 'operation': 'CREATE',
+                'kind': {'group': '', 'version': 'v1',
+                         'kind': doc.get('kind', '')},
+                'namespace': doc['metadata'].get('namespace', ''),
+                'name': doc['metadata'].get('name', ''),
+                'object': doc, 'userInfo': {'username': 'bench'},
+            }}).encode()
+        t0 = time.time()
+        server.handle('/validate/fail', review)
+        lat.append((time.time() - t0) * 1000)
+    return round(statistics.median(lat), 2), len(replicated)
 
 
 def main() -> int:

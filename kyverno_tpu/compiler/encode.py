@@ -601,10 +601,15 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
     elems = _pow2_clamp(_measure_elems(resources, containers), 4, MAX_ELEMS)
     batch.elems = elems
 
-    # gather projections are evaluated first so the gather width can be
-    # sized to the longest observed result list
+    # gather projections are evaluated against the same RFC-7386
+    # merge-patched context the host Context builds (null-valued map keys
+    # stripped; engine/context.py:36 merge_patch) — a variable resolving
+    # to an explicit null must raise NotFound exactly like the host
+    from ..engine.context import merge_patch
+    bases = [merge_patch({}, {'request': {'object': doc}})
+             for doc in resources]
     gather_results = {
-        g: [_run_gather(searcher, doc) for doc in resources]
+        g: [_run_gather_ctx(searcher, base) for base in bases]
         for g, searcher in ((g, _gather_searcher(g)) for g in cps.gathers)}
     longest_g = 1
     for results in gather_results.values():
@@ -637,9 +642,11 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                 if elem is None:
                     row.append(('null', None))
                     continue
-                ctx = {'request': {'object': doc}, 'element': elem,
-                       'element0': elem, 'elementIndex': fe,
-                       'elementIndex0': fe}
+                # element context merges over the base like the host's
+                # add_element (context.py:109) — nulls stripped again
+                ctx = merge_patch(bases[r], {
+                    'element': elem, 'element0': elem,
+                    'elementIndex': fe, 'elementIndex0': fe})
                 m2, v2 = _run_gather_ctx(searcher, ctx)
                 if m2 == 'list':
                     longest_eg = max(longest_eg, len(v2))

@@ -921,16 +921,25 @@ def _is_semverish(v: str) -> bool:
 
 def _suspicious_scalar(view: _View) -> Any:
     """Scalar string values that might trigger the host's runtime range
-    or JSON handling (contains '-', starts with '[', has wildcards, or
-    exceeds the head window) — undecidable beyond plain equality."""
+    or JSON handling (contains '-', leads with '[' after optional
+    whitespace — json.loads tolerates leading whitespace — has wildcards,
+    or exceeds the head window): undecidable beyond plain equality."""
     head = view.lane('str_head')
     w = head.shape[-1]
     pos_valid = jnp.arange(w) < jnp.minimum(view.str_len, w)[..., None]
     has_dash = jnp.any((head == ord('-')) & pos_valid, axis=-1)
-    starts_bracket = head[..., 0] == ord('[')
+    is_space = (head == ord(' ')) | (head == ord('\t')) | \
+        (head == ord('\n')) | (head == ord('\r'))
+    # all-whitespace prefix up to (exclusive) each position
+    space_prefix = jnp.cumprod(is_space.astype(jnp.int32), axis=-1) > 0
+    before_ok = jnp.concatenate(
+        [jnp.ones(head.shape[:-1] + (1,), bool), space_prefix[..., :-1]],
+        axis=-1)
+    leads_bracket = jnp.any(
+        before_ok & (head == ord('[')) & pos_valid, axis=-1)
     hw = view.lane('has_wild') if view.has('has_wild') else \
         jnp.zeros(view.tag.shape, bool)
-    return has_dash | starts_bracket | hw | (view.str_len > w)
+    return has_dash | leads_bracket | hw | (view.str_len > w)
 
 
 def _cond_b_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:

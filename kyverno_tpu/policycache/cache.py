@@ -36,22 +36,28 @@ def _compute_kind(gvk: str) -> str:
     return kind
 
 
+def _is_enforce(action) -> bool:
+    """'Enforce' plus the deprecated lowercase 'enforce'
+    (reference: api/kyverno/v1/spec_types.go:29 Enforce())."""
+    return action in ('Enforce', 'enforce')
+
+
 def _compute_enforce(policy: Policy) -> bool:
     """reference: store.go:76 computeEnforcePolicy"""
-    if policy.validation_failure_action == 'Enforce':
+    if _is_enforce(policy.validation_failure_action):
         return True
-    return any((o.get('action') == 'Enforce')
+    return any(_is_enforce(o.get('action'))
                for o in policy.validation_failure_action_overrides)
 
 
 def _check_overrides(enforce: bool, ns: str, policy: Policy) -> bool:
     """reference: cache.go:78 checkValidationFailureActionOverrides"""
-    action_enforce = policy.validation_failure_action == 'Enforce'
+    action_enforce = _is_enforce(policy.validation_failure_action)
     overrides = policy.validation_failure_action_overrides
     if action_enforce != enforce and (not ns or not overrides):
         return False
     for override in overrides:
-        override_enforce = override.get('action') == 'Enforce'
+        override_enforce = _is_enforce(override.get('action'))
         if override_enforce != enforce and \
                 check_patterns(override.get('namespaces') or [], ns):
             return False

@@ -1,0 +1,322 @@
+"""Resource admission handlers + middleware chain.
+
+The serving pipeline mirrors the reference's handler composition
+(reference: pkg/webhooks/handlers/*.go, pkg/webhooks/resource/handlers.go):
+``with_admission`` decodes/encodes AdmissionReview JSON, ``with_filter``
+drops config-excluded resources, ``with_protection`` denies edits to
+kyverno-managed resources, ``with_dump`` keeps a debug ring buffer; the
+terminal handlers run the engine over the policy cache.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import yaml
+
+from ..api.unstructured import Resource
+from ..engine.api import EngineResponse, RuleStatus
+from ..engine.engine import Engine
+from ..policycache import cache as pcache
+from . import admission
+
+Handler = Callable[[dict], dict]  # AdmissionRequest -> AdmissionResponse
+
+
+# ---------------------------------------------------------------------------
+# block / warning assembly (reference: pkg/webhooks/utils/block.go,
+# warning.go; pkg/utils/engine/response.go:21)
+
+def block_request(responses: List[EngineResponse],
+                  failure_policy: str) -> bool:
+    for er in responses:
+        if er.is_failed() and _enforce(er):
+            return True
+        if er.is_error() and failure_policy == 'Fail':
+            return True
+    return False
+
+
+def _enforce(er: EngineResponse) -> bool:
+    action = er.get_validation_failure_action()
+    return str(action).lower() == 'enforce'
+
+
+def get_blocked_messages(responses: List[EngineResponse]) -> str:
+    """reference: pkg/webhooks/utils/block.go:38 GetBlockedMessages"""
+    if not responses:
+        return ''
+    failures: Dict[str, Dict[str, str]] = {}
+    has_violations = False
+    for er in responses:
+        rule_to_reason: Dict[str, str] = {}
+        for rule in er.policy_response.rules:
+            if rule.status != RuleStatus.PASS:
+                rule_to_reason[rule.name] = rule.message
+                if rule.status == RuleStatus.FAIL:
+                    has_violations = True
+        if rule_to_reason:
+            failures[er.policy_response.policy_name] = rule_to_reason
+    if not failures:
+        return ''
+    pr = responses[0].policy_response
+    resource_name = f'{pr.resource_kind}/{pr.resource_namespace}/' \
+                    f'{pr.resource_name}'
+    action = 'violation' if has_violations else 'error'
+    if len(failures) > 1:
+        action += 's'
+    results = yaml.safe_dump(failures, default_flow_style=False)
+    return f'\n\npolicy {resource_name} for resource {action}: ' \
+           f'\n\n{results}'
+
+
+def get_warning_messages(responses: List[EngineResponse]) -> List[str]:
+    """reference: pkg/webhooks/utils/warning.go:9 GetWarningMessages"""
+    warnings = []
+    for er in responses:
+        for rule in er.policy_response.rules:
+            if rule.status not in (RuleStatus.PASS, RuleStatus.SKIP):
+                warnings.append(
+                    f'policy {er.policy_response.policy_name}.{rule.name}: '
+                    f'{rule.message}')
+    return warnings
+
+
+# ---------------------------------------------------------------------------
+# middleware (reference: pkg/webhooks/handlers/{filter,protect,dump}.go)
+
+def with_filter(configuration, inner: Handler) -> Handler:
+    """Skip resources excluded by the dynamic configuration
+    (reference: pkg/webhooks/handlers/filter.go)."""
+    def handler(request: dict) -> dict:
+        if configuration is not None:
+            kind = (request.get('kind') or {}).get('kind', '')
+            ns = request.get('namespace', '')
+            name = request.get('name', '') or \
+                Resource(admission.request_resource(request)).name
+            if configuration.to_filter(kind, ns, name):
+                return admission.response(request.get('uid', ''), True)
+        return inner(request)
+    return handler
+
+
+def with_protection(enabled: bool, inner: Handler) -> Handler:
+    """Deny user modifications of kyverno-managed resources
+    (reference: pkg/webhooks/handlers/protect.go)."""
+    def handler(request: dict) -> dict:
+        if enabled:
+            new = admission.request_resource(request)
+            old = admission.request_old_resource(request)
+            for obj in (new, old):
+                labels = (obj.get('metadata') or {}).get('labels') or {}
+                if labels.get('app.kubernetes.io/managed-by') == 'kyverno':
+                    username = (request.get('userInfo') or {}).get(
+                        'username', '')
+                    if not username.startswith(
+                            'system:serviceaccount:kyverno:'):
+                        return admission.response(
+                            request.get('uid', ''), False,
+                            'A kyverno managed resource can only be '
+                            'modified by kyverno')
+        return inner(request)
+    return handler
+
+
+class DumpBuffer:
+    """Debug payload ring buffer (reference: handlers/dump.go)."""
+
+    def __init__(self, size: int = 20):
+        self._items = collections.deque(maxlen=size)
+        self._lock = threading.Lock()
+
+    def add(self, item: dict) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def items(self) -> List[dict]:
+        with self._lock:
+            return list(self._items)
+
+
+def with_dump(buffer: Optional[DumpBuffer], inner: Handler) -> Handler:
+    def handler(request: dict) -> dict:
+        resp = inner(request)
+        if buffer is not None:
+            buffer.add({'request': {
+                'uid': request.get('uid'),
+                'kind': request.get('kind'),
+                'namespace': request.get('namespace'),
+                'name': request.get('name'),
+                'operation': request.get('operation'),
+            }, 'response': {k: v for k, v in resp.items() if k != 'patch'},
+                'timestamp': time.time()})
+        return resp
+    return handler
+
+
+def with_admission(inner: Handler) -> Callable[[bytes], bytes]:
+    """AdmissionReview JSON decode/encode wrapper
+    (reference: pkg/webhooks/handlers/admission.go:18)."""
+    def handler(body: bytes) -> bytes:
+        review = json.loads(body)
+        request = admission.parse_review(review)
+        resp = inner(request)
+        return json.dumps(
+            admission.review_response(request, resp)).encode('utf-8')
+    return handler
+
+
+# ---------------------------------------------------------------------------
+# resource handlers (reference: pkg/webhooks/resource/handlers.go)
+
+class ResourceHandlers:
+    """Terminal Validate / Mutate admission handlers.
+
+    ``audit_sink`` receives (request, responses) for async audit-report
+    construction; ``ur_sink`` receives UpdateRequest specs spawned for
+    generate / mutate-existing policies (reference: handlers.go:146-155).
+    """
+
+    def __init__(self, cache: 'pcache.Cache', engine: Optional[Engine] = None,
+                 pc_builder: Optional[admission.PolicyContextBuilder] = None,
+                 configuration=None,
+                 namespace_labels: Optional[Callable[[str], dict]] = None,
+                 audit_sink: Optional[Callable] = None,
+                 ur_sink: Optional[Callable] = None,
+                 registry_client=None):
+        self.cache = cache
+        self.engine = engine or Engine()
+        self.pc_builder = pc_builder or admission.PolicyContextBuilder(
+            configuration)
+        self.configuration = configuration
+        self.namespace_labels = namespace_labels or (lambda ns: {})
+        self.audit_sink = audit_sink
+        self.ur_sink = ur_sink
+        self.registry_client = registry_client
+
+    # -- validate ---------------------------------------------------------
+
+    def validate(self, request: dict,
+                 failure_policy: str = 'Fail') -> dict:
+        """reference: pkg/webhooks/resource/handlers.go:110 Validate"""
+        uid = request.get('uid', '')
+        kind = (request.get('kind') or {}).get('kind', '')
+        ns = request.get('namespace', '')
+        policies = self.cache.get_policies(pcache.VALIDATE_ENFORCE, kind, ns)
+        generate_policies = self.cache.get_policies(pcache.GENERATE, kind, ns)
+        try:
+            pctx = self.pc_builder.build(request)
+        except Exception as e:  # noqa: BLE001
+            return admission.response(uid, False,
+                                      f'failed to build policy context: {e}')
+        pctx.namespace_labels = self.namespace_labels(ns)
+
+        responses: List[EngineResponse] = []
+        for policy in policies:
+            ctx = pctx.copy()
+            ctx.policy = policy
+            responses.append(self.engine.validate(ctx))
+        if block_request(responses, failure_policy):
+            return admission.response(uid, False,
+                                      get_blocked_messages(responses))
+        # async hand-offs: audit-mode policies and generate URs
+        if self.audit_sink is not None:
+            self.audit_sink(request, responses)
+        if self.ur_sink is not None and generate_policies:
+            self._create_update_requests(request, pctx, generate_policies)
+        warnings = get_warning_messages(responses)
+        return admission.response(uid, True, '', warnings)
+
+    def audit_responses(self, request: dict) -> List[EngineResponse]:
+        """Audit-mode engine responses for report construction
+        (reference: validation.go:156 buildAuditResponses)."""
+        kind = (request.get('kind') or {}).get('kind', '')
+        ns = request.get('namespace', '')
+        policies = self.cache.get_policies(pcache.VALIDATE_AUDIT, kind, ns)
+        pctx = self.pc_builder.build(request)
+        pctx.namespace_labels = self.namespace_labels(ns)
+        out = []
+        for policy in policies:
+            ctx = pctx.copy()
+            ctx.policy = policy
+            out.append(self.engine.validate(ctx))
+        return out
+
+    def _create_update_requests(self, request: dict, pctx, policies) -> None:
+        """Spawn UpdateRequests for generate policies on admission
+        (reference: pkg/webhooks/resource/updaterequest.go:20)."""
+        resource = admission.request_resource(request)
+        r = Resource(resource)
+        for policy in policies:
+            self.ur_sink({
+                'type': 'generate',
+                'policy': policy.name,
+                'resource': {
+                    'kind': r.kind, 'apiVersion': r.api_version,
+                    'namespace': r.namespace, 'name': r.name,
+                },
+                'context': {
+                    'userInfo': request.get('userInfo') or {},
+                    'admissionRequestInfo': {
+                        'operation': request.get('operation', ''),
+                    },
+                },
+            })
+
+    # -- mutate -----------------------------------------------------------
+
+    def mutate(self, request: dict, failure_policy: str = 'Fail') -> dict:
+        """reference: pkg/webhooks/resource/handlers.go:157 Mutate +
+        mutation.go:80 applyMutations (sequential, cumulative)."""
+        uid = request.get('uid', '')
+        kind = (request.get('kind') or {}).get('kind', '')
+        ns = request.get('namespace', '')
+        mutate_policies = self.cache.get_policies(pcache.MUTATE, kind, ns)
+        verify_policies = self.cache.get_policies(
+            pcache.VERIFY_IMAGES_MUTATE, kind, ns)
+        try:
+            pctx = self.pc_builder.build(request)
+        except Exception as e:  # noqa: BLE001
+            return admission.response(uid, False,
+                                      f'failed to build policy context: {e}')
+        pctx.namespace_labels = self.namespace_labels(ns)
+
+        patches: List[dict] = []
+        responses: List[EngineResponse] = []
+        for policy in mutate_policies:
+            if not any(r.has_mutate() for r in policy.rules):
+                continue
+            ctx = pctx.copy()
+            ctx.policy = policy
+            er = self.engine.mutate(ctx)
+            policy_patches = [p for rr in er.policy_response.rules
+                              for p in (rr.patches or [])]
+            if policy_patches:
+                patches.extend(policy_patches)
+            # mutations apply cumulatively: the patched resource re-enters
+            # the context for the next policy (mutation.go:123)
+            pctx = pctx.copy()
+            pctx.new_resource = er.patched_resource or pctx.new_resource
+            pctx.json_context.add_resource(pctx.new_resource)
+            responses.append(er)
+            if er.is_error() and failure_policy == 'Fail':
+                return admission.response(
+                    uid, False, get_blocked_messages(responses))
+        for policy in verify_policies:
+            ctx = pctx.copy()
+            ctx.policy = policy
+            er, _meta = self.engine.verify_and_patch_images(
+                ctx, self.registry_client)
+            iv_patches = [p for rr in er.policy_response.rules
+                          for p in (rr.patches or [])]
+            patches.extend(iv_patches)
+            responses.append(er)
+            if er.is_failed():
+                return admission.response(
+                    uid, False, get_blocked_messages(responses))
+        warnings = get_warning_messages(responses)
+        return admission.mutation_response(uid, patches, warnings)

@@ -1,0 +1,182 @@
+"""HTTPS admission webhook server.
+
+Route table and lifecycle mirror the reference's server
+(reference: pkg/webhooks/server.go:69 NewServer, routes :102-115):
+
+  POST /validate[/fail|/ignore]     resource validation
+  POST /mutate[/fail|/ignore]       resource mutation
+  POST /policyvalidate              policy CR validation
+  POST /policymutate                policy CR defaulting
+  POST /exceptionvalidate           PolicyException validation
+  POST /verifymutate                lease heartbeat mutation
+  GET  /health/liveness             liveness probe
+  GET  /health/readiness            readiness probe
+
+TLS is loaded from cert/key PEM files when provided (the reference reads
+its pair per-handshake from the certmanager secret, server.go:155-177).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from . import admission
+from .handlers import (DumpBuffer, Handler, ResourceHandlers, with_dump,
+                       with_filter, with_protection)
+
+
+def _allow_all(request: dict) -> dict:
+    return admission.response(request.get('uid', ''), True)
+
+
+class PolicyHandlers:
+    """Policy CR admission (validate/mutate) — overridden by the policy
+    lifecycle module (reference: pkg/webhooks/policy/handlers.go)."""
+
+    def validate(self, request: dict) -> dict:
+        from ..policy.validate import validate_policy_admission
+        return validate_policy_admission(request)
+
+    def mutate(self, request: dict) -> dict:
+        return _allow_all(request)
+
+
+class ExceptionHandlers:
+    def validate(self, request: dict) -> dict:
+        from ..policy.validate import validate_exception_admission
+        return validate_exception_admission(request)
+
+
+class WebhookServer:
+    """Threaded admission server over the handler chain.
+
+    ``routes()`` exposes the request→response callables directly so tests
+    and the in-process latency benchmark can drive the full middleware
+    stack without sockets.
+    """
+
+    def __init__(self, resource_handlers: ResourceHandlers,
+                 policy_handlers: Optional[PolicyHandlers] = None,
+                 exception_handlers: Optional[ExceptionHandlers] = None,
+                 configuration=None,
+                 protection_enabled: bool = False,
+                 dump: bool = False,
+                 host: str = '127.0.0.1', port: int = 9443,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
+        self.resource_handlers = resource_handlers
+        self.policy_handlers = policy_handlers or PolicyHandlers()
+        self.exception_handlers = exception_handlers or ExceptionHandlers()
+        self.configuration = configuration
+        self.dump_buffer = DumpBuffer() if dump else None
+        self.host = host
+        self.port = port
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = False
+        self._routes = self._build_routes(protection_enabled)
+
+    # -- handler chain ----------------------------------------------------
+
+    def _chain(self, terminal: Handler, protect: bool) -> Handler:
+        h = terminal
+        h = with_protection(protect, h)
+        h = with_filter(self.configuration, h)
+        h = with_dump(self.dump_buffer, h)
+        return h
+
+    def _build_routes(self, protect: bool) -> Dict[str, Handler]:
+        rh = self.resource_handlers
+        routes: Dict[str, Handler] = {}
+        for suffix, fp in (('', 'Fail'), ('/fail', 'Fail'),
+                           ('/ignore', 'Ignore')):
+            routes[f'/validate{suffix}'] = self._chain(
+                lambda req, fp=fp: rh.validate(req, fp), protect)
+            routes[f'/mutate{suffix}'] = self._chain(
+                lambda req, fp=fp: rh.mutate(req, fp), protect)
+        routes['/policyvalidate'] = self.policy_handlers.validate
+        routes['/policymutate'] = self.policy_handlers.mutate
+        routes['/exceptionvalidate'] = self.exception_handlers.validate
+        routes['/verifymutate'] = _allow_all
+        return routes
+
+    def routes(self) -> Dict[str, Handler]:
+        return dict(self._routes)
+
+    def handle(self, path: str, body: bytes) -> bytes:
+        """Dispatch one POST body through the route's handler chain."""
+        handler = self._routes.get(path)
+        if handler is None:
+            raise KeyError(path)
+        review = json.loads(body)
+        request = admission.parse_review(review)
+        resp = handler(request)
+        return json.dumps(
+            admission.review_response(request, resp)).encode('utf-8')
+
+    # -- http lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 - quiet
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path in ('/health/liveness', '/health/readiness'):
+                    ok = self.path == '/health/liveness' or server._ready
+                    self.send_response(200 if ok else 503)
+                    self.end_headers()
+                    self.wfile.write(b'ok' if ok else b'not ready')
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get('Content-Length', 0))
+                body = self.rfile.read(length)
+                try:
+                    out = server.handle(self.path, body)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode('utf-8'))
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        if self.certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self._ready = True
+
+    def stop(self) -> None:
+        self._ready = False
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -170,3 +170,103 @@ class TestForEachEquivalence:
                         r.name: (r.status, r.message)
                         for r in resp.policy_response.rules}
             assert got == host, f'divergence on {resource}'
+
+
+class TestNullContextSemantics:
+    """The host Context strips null-valued map keys (RFC-7386 merge
+    patch), so variables resolving to explicit nulls raise NotFound —
+    the encoder must do the same (review regression)."""
+
+    def _check(self, policies, resource):
+        engine = Engine()
+        scanner = BatchScanner(policies)
+        [resp_list] = scanner.scan([resource])
+        host = {}
+        for policy in policies:
+            resp = engine.apply_background_checks(
+                PolicyContext(policy, new_resource=resource))
+            host.update({(policy.name, r.name): (r.status, r.message)
+                         for r in resp.policy_response.rules})
+        got = {}
+        for resp in resp_list:
+            got.update({(resp.policy_response.policy_name, r.name):
+                        (r.status, r.message)
+                        for r in resp.policy_response.rules})
+        assert got == host, (got, host)
+
+    def test_explicit_null_element_key_is_error(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: t, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: r
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: m
+        foreach:
+          - list: request.object.spec.containers
+            deny:
+              conditions:
+                all:
+                  - key: X
+                    operator: AnyNotIn
+                    value: "{{ element.tagstr }}"
+"""))
+        pod = {'apiVersion': 'v1', 'kind': 'Pod',
+               'metadata': {'name': 'p', 'namespace': 'd'},
+               'spec': {'containers': [
+                   {'name': 'c', 'image': 'x', 'tagstr': None}]}}
+        self._check([policy], pod)
+
+    def test_explicit_null_rule_level_key_is_error(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: t2, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: r
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: m
+        deny:
+          conditions:
+            any:
+              - key: "{{ request.object.spec.hostNetwork }}"
+                operator: Equals
+                value: true
+"""))
+        pod = {'apiVersion': 'v1', 'kind': 'Pod',
+               'metadata': {'name': 'p', 'namespace': 'd'},
+               'spec': {'hostNetwork': None,
+                        'containers': [{'name': 'c', 'image': 'x'}]}}
+        self._check([policy], pod)
+
+    def test_whitespace_prefixed_json_value(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: t3, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: r
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: m
+        foreach:
+          - list: request.object.spec.containers
+            deny:
+              conditions:
+                all:
+                  - key: X
+                    operator: AnyIn
+                    value: "{{ element.tagstr }}"
+"""))
+        for tag in (' ["X"]', '\t["X"]', '["X"]', '["Y"]', 'X', ' X'):
+            pod = {'apiVersion': 'v1', 'kind': 'Pod',
+                   'metadata': {'name': 'p', 'namespace': 'd'},
+                   'spec': {'containers': [
+                       {'name': 'c', 'image': 'x', 'tagstr': tag}]}}
+            self._check([policy], pod)

@@ -1,0 +1,177 @@
+"""Policy lifecycle: controller UR spawning + admission validation
+(reference: pkg/policy/policy_controller.go, pkg/policy/validate.go)."""
+
+import pytest
+import yaml
+
+from kyverno_tpu.dclient.client import FakeClient
+from kyverno_tpu.policy.controller import PolicyController
+from kyverno_tpu.policy.validate import (PolicyValidationError,
+                                         validate_policy)
+
+GENERATE_EXISTING = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-quota
+spec:
+  generateExisting: true
+  rules:
+    - name: generate-quota
+      match: {any: [{resources: {kinds: [Namespace]}}]}
+      generate:
+        apiVersion: v1
+        kind: ResourceQuota
+        name: default-quota
+        namespace: "{{request.object.metadata.name}}"
+        data:
+          spec: {hard: {pods: '10'}}
+""")
+
+MUTATE_EXISTING = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: label-existing
+spec:
+  mutateExistingOnPolicyUpdate: true
+  rules:
+    - name: label-them
+      match: {any: [{resources: {kinds: [ConfigMap]}}]}
+      mutate:
+        targets:
+          - apiVersion: v1
+            kind: ConfigMap
+            namespace: default
+        patchStrategicMerge:
+          metadata:
+            labels:
+              seen: "yes"
+""")
+
+
+def make_client():
+    client = FakeClient()
+    client.create_resource('v1', 'Namespace', '', {
+        'apiVersion': 'v1', 'kind': 'Namespace',
+        'metadata': {'name': 'team-a'}})
+    client.create_resource('v1', 'ConfigMap', 'default', {
+        'apiVersion': 'v1', 'kind': 'ConfigMap',
+        'metadata': {'name': 'cm1', 'namespace': 'default'}})
+    return client
+
+
+class TestPolicyController:
+    def test_generate_existing_spawns_urs(self):
+        client = make_client()
+        ctrl = PolicyController(client)
+        ctrl.add_policy(GENERATE_EXISTING)
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest',
+                                   'kyverno', None)
+        assert len(urs) == 1
+        spec = urs[0]['spec']
+        assert spec['requestType'] == 'generate'
+        assert spec['resource']['kind'] == 'Namespace'
+        assert spec['resource']['name'] == 'team-a'
+
+    def test_no_urs_without_generate_existing(self):
+        client = make_client()
+        doc = dict(GENERATE_EXISTING)
+        doc['spec'] = dict(doc['spec'])
+        doc['spec'].pop('generateExisting')
+        ctrl = PolicyController(client)
+        ctrl.add_policy(doc)
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest',
+                                   'kyverno', None)
+        assert urs == []
+
+    def test_mutate_existing_spawns_urs(self):
+        client = make_client()
+        ctrl = PolicyController(client)
+        ctrl.add_policy(MUTATE_EXISTING)
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest',
+                                   'kyverno', None)
+        assert len(urs) == 1
+        assert urs[0]['spec']['requestType'] == 'mutate'
+
+    def test_update_only_on_spec_change(self):
+        client = make_client()
+        ctrl = PolicyController(client)
+        ctrl.add_policy(GENERATE_EXISTING)
+        before = len(client.list_resource(
+            'kyverno.io/v1beta1', 'UpdateRequest', 'kyverno', None))
+        # metadata-only change: no new URs
+        changed = dict(GENERATE_EXISTING)
+        ctrl.update_policy(GENERATE_EXISTING, changed)
+        after = len(client.list_resource(
+            'kyverno.io/v1beta1', 'UpdateRequest', 'kyverno', None))
+        assert after == before
+
+
+class TestPolicyValidation:
+    def base(self):
+        return yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: p}
+spec:
+  rules:
+    - name: r1
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: m
+        pattern: {metadata: {name: "?*"}}
+""")
+
+    def test_accepts_valid(self):
+        assert validate_policy(self.base()) == []
+
+    def test_duplicate_rule_names(self):
+        doc = self.base()
+        doc['spec']['rules'].append(dict(doc['spec']['rules'][0]))
+        with pytest.raises(PolicyValidationError, match='duplicate'):
+            validate_policy(doc)
+
+    def test_multiple_rule_types(self):
+        doc = self.base()
+        doc['spec']['rules'][0]['mutate'] = {
+            'patchStrategicMerge': {'metadata': {}}}
+        with pytest.raises(PolicyValidationError, match='exactly one'):
+            validate_policy(doc)
+
+    def test_any_all_conflict(self):
+        doc = self.base()
+        doc['spec']['rules'][0]['match'] = {
+            'any': [{'resources': {'kinds': ['Pod']}}],
+            'all': [{'resources': {'kinds': ['Pod']}}]}
+        with pytest.raises(PolicyValidationError, match='together'):
+            validate_policy(doc)
+
+    def test_invalid_condition_operator(self):
+        doc = self.base()
+        doc['spec']['rules'][0]['preconditions'] = {
+            'all': [{'key': 'x', 'operator': 'Matches', 'value': 'y'}]}
+        with pytest.raises(PolicyValidationError, match='invalid operator'):
+            validate_policy(doc)
+
+    def test_json_patch_slash(self):
+        doc = self.base()
+        doc['spec']['rules'][0].pop('validate')
+        doc['spec']['rules'][0]['mutate'] = {
+            'patchesJson6902': '- {op: add, path: "x/y", value: 1}'}
+        with pytest.raises(PolicyValidationError, match='forward slash'):
+            validate_policy(doc)
+
+    def test_background_userinfo_rejected(self):
+        doc = self.base()
+        doc['spec']['rules'][0]['validate']['message'] = \
+            'user {{request.userInfo.username}} denied'
+        with pytest.raises(PolicyValidationError, match='background'):
+            validate_policy(doc)
+
+    def test_background_false_allows_userinfo(self):
+        doc = self.base()
+        doc['spec']['background'] = False
+        doc['spec']['rules'][0]['validate']['message'] = \
+            'user {{request.userInfo.username}} denied'
+        assert validate_policy(doc) == []

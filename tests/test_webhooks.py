@@ -1,0 +1,281 @@
+"""Admission serving layer: end-to-end AdmissionReview round trips
+through the handler chain (reference behaviors: pkg/webhooks)."""
+
+import json
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.config.config import Configuration
+from kyverno_tpu.policycache.cache import Cache
+from kyverno_tpu.webhooks import admission
+from kyverno_tpu.webhooks.handlers import ResourceHandlers
+from kyverno_tpu.webhooks.server import WebhookServer
+
+ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-labels
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+AUDIT_POLICY = ENFORCE_POLICY.replace(
+    'enforce', 'audit').replace('require-labels', 'audit-labels')
+
+MUTATE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-default-label
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: add-managed
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              +(managed): "yes"
+"""
+
+
+def make_cache(*policy_yamls):
+    cache = Cache()
+    policies = [Policy(d) for y in policy_yamls
+                for d in yaml.safe_load_all(y)]
+    cache.warm_up(policies)
+    return cache
+
+
+def pod(labels=None, name='test-pod'):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'labels': labels or {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def review(resource, operation='CREATE', old=None):
+    return {
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': 'uid-1',
+            'kind': {'group': '', 'version': 'v1',
+                     'kind': resource.get('kind', '')},
+            'namespace': (resource.get('metadata') or {}).get(
+                'namespace', ''),
+            'name': (resource.get('metadata') or {}).get('name', ''),
+            'operation': operation,
+            'object': resource,
+            'oldObject': old,
+            'userInfo': {'username': 'alice', 'groups': []},
+        },
+    }
+
+
+def serve(cache, **kwargs):
+    handlers = ResourceHandlers(cache, **kwargs)
+    return WebhookServer(handlers, configuration=Configuration())
+
+
+class TestValidateWebhook:
+    def test_enforce_denies_with_blocked_message(self):
+        server = serve(make_cache(ENFORCE_POLICY))
+        body = server.handle('/validate/fail',
+                             json.dumps(review(pod())).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        msg = resp['status']['message']
+        assert 'require-labels' in msg
+        assert 'require-team' in msg
+        assert 'validation error' in msg and 'team' in msg
+        assert msg.startswith('\n\npolicy Pod/default/test-pod')
+
+    def test_enforce_allows_compliant(self):
+        server = serve(make_cache(ENFORCE_POLICY))
+        body = server.handle(
+            '/validate/fail',
+            json.dumps(review(pod({'team': 'infra'}))).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is True
+        assert 'warnings' not in resp
+
+    def test_audit_mode_allows_and_reports(self):
+        audits = []
+        handlers = ResourceHandlers(
+            make_cache(AUDIT_POLICY),
+            audit_sink=lambda req, responses: audits.append(req))
+        server = WebhookServer(handlers)
+        body = server.handle('/validate',
+                             json.dumps(review(pod())).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is True
+        assert audits  # audit hand-off happened
+        # the audit path evaluates audit-mode policies
+        audit_responses = handlers.audit_responses(
+            review(pod())['request'])
+        assert audit_responses
+        assert audit_responses[0].is_failed()
+
+
+class TestMutateWebhook:
+    def test_mutation_patch_applies(self):
+        server = serve(make_cache(MUTATE_POLICY))
+        body = server.handle('/mutate',
+                             json.dumps(review(pod())).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is True
+        patches = admission.decode_patch(resp)
+        assert any(p.get('path', '').endswith('managed') or
+                   'managed' in str(p.get('value', '')) for p in patches)
+
+    def test_no_mutation_when_present(self):
+        server = serve(make_cache(MUTATE_POLICY))
+        body = server.handle(
+            '/mutate',
+            json.dumps(review(pod({'managed': 'no'}))).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is True
+        assert admission.decode_patch(resp) == []
+
+
+class TestMiddleware:
+    def test_filter_excludes_configured_resources(self):
+        config = Configuration()
+        config.load({'data': {'resourceFilters':
+                              '[Pod,default,excluded-*]'}})
+        handlers = ResourceHandlers(make_cache(ENFORCE_POLICY),
+                                    configuration=config)
+        server = WebhookServer(handlers, configuration=config)
+        body = server.handle(
+            '/validate/fail',
+            json.dumps(review(pod(name='excluded-pod'))).encode())
+        assert json.loads(body)['response']['allowed'] is True
+        body = server.handle(
+            '/validate/fail',
+            json.dumps(review(pod(name='other-pod'))).encode())
+        assert json.loads(body)['response']['allowed'] is False
+
+    def test_protection_denies_managed_edits(self):
+        handlers = ResourceHandlers(make_cache())
+        server = WebhookServer(handlers, protection_enabled=True)
+        managed = pod()
+        managed['metadata']['labels'] = {
+            'app.kubernetes.io/managed-by': 'kyverno'}
+        body = server.handle('/validate',
+                             json.dumps(review(managed)).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        assert 'kyverno managed resource' in resp['status']['message']
+
+
+class TestPolicyAdmission:
+    def test_valid_policy_accepted(self):
+        server = serve(make_cache())
+        doc = next(yaml.safe_load_all(ENFORCE_POLICY))
+        body = server.handle('/policyvalidate',
+                             json.dumps(review(doc)).encode())
+        assert json.loads(body)['response']['allowed'] is True
+
+    def test_invalid_policy_rejected(self):
+        server = serve(make_cache())
+        doc = next(yaml.safe_load_all(ENFORCE_POLICY))
+        doc['spec']['rules'][0].pop('validate')
+        body = server.handle('/policyvalidate',
+                             json.dumps(review(doc)).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        assert 'exactly one of' in resp['status']['message']
+
+    def test_background_userinfo_var_rejected(self):
+        server = serve(make_cache())
+        doc = next(yaml.safe_load_all(ENFORCE_POLICY))
+        doc['spec']['rules'][0]['validate']['message'] = \
+            '{{request.userInfo.username}} may not do this'
+        body = server.handle('/policyvalidate',
+                             json.dumps(review(doc)).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        assert 'background' in resp['status']['message']
+
+    def test_exception_validation(self):
+        server = serve(make_cache())
+        ex = {'apiVersion': 'kyverno.io/v2alpha1',
+              'kind': 'PolicyException',
+              'metadata': {'name': 'x', 'namespace': 'default'},
+              'spec': {'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+                       'exceptions': [{'policyName': 'p',
+                                       'ruleNames': ['r']}]}}
+        body = server.handle('/exceptionvalidate',
+                             json.dumps(review(ex)).encode())
+        assert json.loads(body)['response']['allowed'] is True
+        ex['spec']['exceptions'] = []
+        body = server.handle('/exceptionvalidate',
+                             json.dumps(review(ex)).encode())
+        assert json.loads(body)['response']['allowed'] is False
+
+
+class TestHTTPServer:
+    def test_http_round_trip_and_probes(self):
+        import urllib.request
+        server = serve(make_cache(ENFORCE_POLICY))
+        server.port = 0  # ephemeral
+        server.start()
+        try:
+            base = f'http://127.0.0.1:{server.port}'
+            with urllib.request.urlopen(f'{base}/health/liveness') as r:
+                assert r.status == 200
+            with urllib.request.urlopen(f'{base}/health/readiness') as r:
+                assert r.status == 200
+            req = urllib.request.Request(
+                f'{base}/validate/fail',
+                data=json.dumps(review(pod())).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req) as r:
+                resp = json.loads(r.read())['response']
+            assert resp['allowed'] is False
+        finally:
+            server.stop()
+
+
+class TestGenerateHandOff:
+    def test_generate_policy_creates_update_request(self):
+        urs = []
+        generate_policy = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-networkpolicy
+spec:
+  rules:
+    - name: default-deny
+      match: {any: [{resources: {kinds: [Namespace]}}]}
+      generate:
+        apiVersion: networking.k8s.io/v1
+        kind: NetworkPolicy
+        name: default-deny
+        namespace: "{{request.object.metadata.name}}"
+        data:
+          spec: {podSelector: {}, policyTypes: [Ingress]}
+"""
+        handlers = ResourceHandlers(make_cache(generate_policy),
+                                    ur_sink=urs.append)
+        server = WebhookServer(handlers)
+        ns = {'apiVersion': 'v1', 'kind': 'Namespace',
+              'metadata': {'name': 'team-a'}}
+        body = server.handle('/validate', json.dumps(review(ns)).encode())
+        assert json.loads(body)['response']['allowed'] is True
+        assert urs and urs[0]['type'] == 'generate'
+        assert urs[0]['policy'] == 'add-networkpolicy'
