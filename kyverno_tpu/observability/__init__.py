@@ -1,0 +1,5 @@
+"""Observability: metrics instruments, K8s event generation, structured
+logging (reference: pkg/metrics, pkg/event, pkg/logging)."""
+
+from .metrics import MetricsRegistry  # noqa: F401
+from .events import EventGenerator  # noqa: F401

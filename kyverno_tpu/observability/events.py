@@ -1,0 +1,119 @@
+"""Kubernetes Event generation for policy violations/applications.
+
+Bounded workqueue drained by worker threads creating v1 Events
+(reference: pkg/event/controller.go:106 Run — 3 workers, queue bound
+1000 via the maxQueuedEvents flag, cmd/kyverno/main.go:234)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from ..engine.api import EngineResponse, RuleStatus
+
+SOURCE_ADMISSION = 'kyverno-admission'
+SOURCE_SCAN = 'kyverno-scan'
+
+REASON_POLICY_VIOLATION = 'PolicyViolation'
+REASON_POLICY_APPLIED = 'PolicyApplied'
+REASON_POLICY_ERROR = 'PolicyError'
+
+
+def new_event(resource_ref: dict, reason: str, message: str,
+              source: str = SOURCE_ADMISSION) -> dict:
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Event',
+        'metadata': {
+            'generateName': 'kyverno-event-',
+            'namespace': resource_ref.get('namespace') or 'default',
+        },
+        'involvedObject': resource_ref,
+        'reason': reason,
+        'message': message,
+        'source': {'component': source},
+        'type': 'Warning' if reason != REASON_POLICY_APPLIED else 'Normal',
+    }
+
+
+def events_for_response(response: EngineResponse,
+                        blocked: bool = False) -> List[dict]:
+    """reference: pkg/webhooks/utils/event.go GenerateEvents"""
+    pr = response.policy_response
+    ref = {'kind': pr.resource_kind, 'namespace': pr.resource_namespace,
+           'name': pr.resource_name, 'apiVersion': pr.resource_api_version}
+    out: List[dict] = []
+    for rule in pr.rules:
+        if rule.status == RuleStatus.FAIL:
+            out.append(new_event(
+                ref, REASON_POLICY_VIOLATION,
+                f'policy {pr.policy_name}/{rule.name} fail: '
+                f'{rule.message}'))
+        elif rule.status == RuleStatus.ERROR:
+            out.append(new_event(
+                ref, REASON_POLICY_ERROR,
+                f'policy {pr.policy_name}/{rule.name} error: '
+                f'{rule.message}'))
+    return out
+
+
+class EventGenerator:
+    """Buffered event emitter (reference: pkg/event/controller.go)."""
+
+    MAX_QUEUED = 1000
+    WORKERS = 3
+
+    def __init__(self, client, max_queued: Optional[int] = None):
+        self.client = client
+        self._queue: 'queue.Queue[dict]' = queue.Queue(
+            maxsize=max_queued or self.MAX_QUEUED)
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self.dropped = 0
+
+    def add(self, *events: dict) -> None:
+        for ev in events:
+            try:
+                self._queue.put_nowait(ev)
+            except queue.Full:
+                self.dropped += 1  # the reference drops on overflow too
+
+    def run(self) -> None:
+        for _ in range(self.WORKERS):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._emit(ev)
+            self._queue.task_done()
+
+    def _emit(self, ev: dict) -> None:
+        ns = ev['metadata'].get('namespace', 'default')
+        ev = dict(ev)
+        ev.setdefault('metadata', {})
+        ev['metadata'] = dict(ev['metadata'])
+        ev['metadata']['name'] = \
+            f"{ev['metadata'].get('generateName', 'ev-')}{time.time_ns()}"
+        try:
+            self.client.create_resource('v1', 'Event', ns, ev)
+        except Exception:  # noqa: BLE001 - event loss is tolerated
+            pass
+
+    def drain(self, timeout: float = 5.0) -> None:
+        deadline = time.time() + timeout
+        while not self._queue.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads = []

@@ -1,0 +1,135 @@
+"""Metrics instruments with Prometheus text exposition.
+
+Mirrors the reference's OTel instrument set (reference:
+pkg/metrics/metrics.go:91-224 — kyverno_policy_results_total,
+kyverno_policy_execution_duration_seconds, kyverno_policy_changes_total,
+kyverno_admission_review_duration_seconds, kyverno_client_queries_total)
+without external dependencies: counters and histograms keyed by label
+tuples, rendered in Prometheus text format for a /metrics endpoint.
+Per-metric disable/relabel follows the dynamic metrics configuration
+(reference: pkg/config/metricsconfig.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                    2.5, 5.0, 10.0)
+
+
+class MetricsRegistry:
+    def __init__(self, disabled: Optional[List[str]] = None):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[Tuple, float]] = {}
+        self._hists: Dict[str, Dict[Tuple, List]] = {}
+        self._label_names: Dict[str, Tuple[str, ...]] = {}
+        self._disabled = set(disabled or [])
+
+    def configure(self, disabled: List[str]) -> None:
+        with self._lock:
+            self._disabled = set(disabled)
+
+    def inc(self, name: str, amount: float = 1.0, **labels) -> None:
+        if name in self._disabled:
+            return
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._label_names.setdefault(
+                name, tuple(k for k, _ in key))
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + amount
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        if name in self._disabled:
+            return
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._label_names.setdefault(
+                name, tuple(k for k, _ in key))
+            series = self._hists.setdefault(name, {})
+            entry = series.get(key)
+            if entry is None:
+                entry = [0, 0.0, [0] * len(_DEFAULT_BUCKETS)]
+                series[key] = entry
+            entry[0] += 1
+            entry[1] += value
+            for i, bound in enumerate(_DEFAULT_BUCKETS):
+                if value <= bound:
+                    entry[2][i] += 1
+
+    # -- reads -----------------------------------------------------------
+
+    def counter_value(self, name: str, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._counters.get(name, {}).get(key, 0.0)
+
+    def counter_total(self, name: str) -> float:
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: List[str] = []
+        with self._lock:
+            for name in sorted(self._counters):
+                out.append(f'# TYPE {name} counter')
+                for key, value in sorted(self._counters[name].items()):
+                    out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
+            for name in sorted(self._hists):
+                out.append(f'# TYPE {name} histogram')
+                for key, (count, total, buckets) in sorted(
+                        self._hists[name].items()):
+                    cum = 0
+                    for bound, b in zip(_DEFAULT_BUCKETS, buckets):
+                        cum += b
+                        lk = key + (('le', _fmt(bound)),)
+                        out.append(
+                            f'{name}_bucket{_fmt_labels(lk)} {cum}')
+                    lk = key + (('le', '+Inf'),)
+                    out.append(f'{name}_bucket{_fmt_labels(lk)} {count}')
+                    out.append(f'{name}_sum{_fmt_labels(key)} '
+                               f'{_fmt(total)}')
+                    out.append(f'{name}_count{_fmt_labels(key)} {count}')
+        return '\n'.join(out) + '\n'
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _fmt_labels(key: Tuple) -> str:
+    if not key:
+        return ''
+    parts = ','.join(f'{k}="{v}"' for k, v in key)
+    return '{' + parts + '}'
+
+
+# instrument names (reference: pkg/metrics/metrics.go:91-224)
+POLICY_RESULTS = 'kyverno_policy_results_total'
+POLICY_EXECUTION_DURATION = 'kyverno_policy_execution_duration_seconds'
+POLICY_CHANGES = 'kyverno_policy_changes_total'
+ADMISSION_REVIEW_DURATION = 'kyverno_admission_review_duration_seconds'
+ADMISSION_REQUESTS = 'kyverno_admission_requests_total'
+CLIENT_QUERIES = 'kyverno_client_queries_total'
+
+
+def record_policy_results(registry: MetricsRegistry, response,
+                          operation: str = '') -> None:
+    """reference: pkg/metrics/policyresults/metrics.go"""
+    pr = response.policy_response
+    for rule in pr.rules:
+        registry.inc(
+            POLICY_RESULTS,
+            policy_name=pr.policy_name,
+            rule_name=rule.name,
+            rule_result=str(rule.status),
+            rule_type=str(rule.rule_type),
+            resource_kind=pr.resource_kind,
+            resource_namespace=pr.resource_namespace,
+            resource_request_operation=operation.lower())
+    registry.observe(
+        POLICY_EXECUTION_DURATION, pr.processing_time or 0.0,
+        policy_name=pr.policy_name)

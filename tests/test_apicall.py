@@ -1,0 +1,147 @@
+"""APICall / ServiceCall / imageRegistry context transports
+(reference: pkg/engine/apicall/apiCall.go, pkg/engine/jsonContext.go)."""
+
+import json
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.engine.apicall import APICallExecutor, make_context_loader
+from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+from kyverno_tpu.engine.context import Context, ContextError
+from kyverno_tpu.engine.engine import Engine
+
+
+def fake_http(responses):
+    calls = []
+
+    def transport(method, url, headers, body, ca_bundle=''):
+        calls.append({'method': method, 'url': url, 'headers': headers,
+                      'body': body})
+        return json.dumps(responses[url]).encode()
+    transport.calls = calls
+    return transport
+
+
+class TestAPICall:
+    def test_service_get_with_jmespath(self):
+        transport = fake_http({'http://svc/data': {'items': [1, 2, 3]}})
+        ex = APICallExecutor(http_transport=transport,
+                             token_reader=lambda: 'tok')
+        ctx = Context()
+        result = ex({'name': 'e', 'apiCall': {
+            'service': {'url': 'http://svc/data', 'method': 'GET'},
+            'jmesPath': 'items | length(@)'}}, ctx)
+        assert result == 3
+        assert transport.calls[0]['headers']['Authorization'] == 'Bearer tok'
+
+    def test_service_post_data(self):
+        transport = fake_http({'http://svc/q': {'ok': True}})
+        ex = APICallExecutor(http_transport=transport,
+                             token_reader=lambda: '')
+        result = ex({'name': 'e', 'apiCall': {
+            'service': {'url': 'http://svc/q', 'method': 'POST'},
+            'data': [{'key': 'a', 'value': 1}]}}, Context())
+        assert result == {'ok': True}
+        assert json.loads(transport.calls[0]['body']) == {'a': 1}
+
+    def test_url_path_uses_cluster_client(self):
+        def raw(path):
+            assert path == '/api/v1/namespaces'
+            return json.dumps({'items': [{'metadata': {'name': 'a'}}]}).encode()
+        ex = APICallExecutor(raw_abs_path=raw,
+                             http_transport=fake_http({}))
+        result = ex({'name': 'e', 'apiCall': {
+            'urlPath': '/api/v1/namespaces',
+            'jmesPath': 'items[0].metadata.name'}}, Context())
+        assert result == 'a'
+
+    def test_variable_substitution_in_url(self):
+        transport = fake_http({'http://svc/ns/default': {'v': 1}})
+        ex = APICallExecutor(http_transport=transport)
+        ctx = Context()
+        ctx.add_resource({'metadata': {'namespace': 'default'}})
+        result = ex({'name': 'e', 'apiCall': {'service': {
+            'url': 'http://svc/ns/{{request.object.metadata.namespace}}',
+            'method': 'GET'}}}, ctx)
+        assert result == {'v': 1}
+
+    def test_errors_are_context_errors(self):
+        def boom(*a, **k):
+            raise OSError('connection refused')
+        ex = APICallExecutor(http_transport=boom)
+        with pytest.raises(ContextError):
+            ex({'name': 'e', 'apiCall': {
+                'service': {'url': 'http://x', 'method': 'GET'}}}, Context())
+
+
+class TestEngineWiring:
+    def test_policy_with_apicall_context(self):
+        transport = fake_http({'http://audit/allowed': ['nginx', 'redis']})
+        loader = make_context_loader(http_transport=transport,
+                                     token_reader=lambda: '')
+        engine = Engine(context_loader=loader)
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: allowed-images, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: check
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      context:
+        - name: allowed
+          apiCall:
+            service: {url: "http://audit/allowed", method: GET}
+      validate:
+        message: image not allowed
+        deny:
+          conditions:
+            all:
+              - key: "{{request.object.spec.containers[0].image}}"
+                operator: AnyNotIn
+                value: "{{allowed}}"
+"""))
+        def run(image):
+            pod = {'apiVersion': 'v1', 'kind': 'Pod',
+                   'metadata': {'name': 'p', 'namespace': 'd'},
+                   'spec': {'containers': [{'name': 'c', 'image': image}]}}
+            resp = engine.validate(PolicyContext(policy, new_resource=pod))
+            return resp.policy_response.rules[0].status
+        assert run('nginx') == RuleStatus.PASS
+        assert run('evil') == RuleStatus.FAIL
+
+    def test_image_registry_context(self):
+        from kyverno_tpu.registry.client import MockRegistryClient
+        rclient = MockRegistryClient()
+        rclient.add_image('ghcr.io/org/app:v1', 'sha256:' + 'a' * 64)
+        loader = make_context_loader(registry_client=rclient)
+        engine = Engine(context_loader=loader)
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: img-meta, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: check
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      context:
+        - name: img
+          imageRegistry:
+            reference: "{{request.object.spec.containers[0].image}}"
+      validate:
+        message: must resolve
+        deny:
+          conditions:
+            all:
+              - key: "{{img.registry}}"
+                operator: NotEquals
+                value: ghcr.io
+"""))
+        pod = {'apiVersion': 'v1', 'kind': 'Pod',
+               'metadata': {'name': 'p', 'namespace': 'd'},
+               'spec': {'containers': [
+                   {'name': 'c', 'image': 'ghcr.io/org/app:v1'}]}}
+        resp = engine.validate(PolicyContext(policy, new_resource=pod))
+        assert resp.policy_response.rules[0].status == RuleStatus.PASS

@@ -1,0 +1,101 @@
+"""Observability: metrics instruments, event generation, structured logs
+(reference: pkg/metrics, pkg/event, pkg/logging)."""
+
+import json
+import logging
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.dclient.client import FakeClient
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.observability.events import (EventGenerator,
+                                              events_for_response)
+from kyverno_tpu.observability.metrics import (POLICY_RESULTS,
+                                               MetricsRegistry,
+                                               record_policy_results)
+
+POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: m
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: audit
+  rules:
+    - name: r
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: needs team
+        pattern: {metadata: {labels: {team: "?*"}}}
+""")
+
+
+def run_engine(labels):
+    pod = {'apiVersion': 'v1', 'kind': 'Pod',
+           'metadata': {'name': 'p', 'namespace': 'd', 'labels': labels},
+           'spec': {}}
+    return Engine().validate(PolicyContext(Policy(POLICY),
+                                           new_resource=pod))
+
+
+class TestMetrics:
+    def test_policy_results_counter(self):
+        reg = MetricsRegistry()
+        record_policy_results(reg, run_engine({}), 'CREATE')
+        record_policy_results(reg, run_engine({'team': 'x'}), 'CREATE')
+        assert reg.counter_total(POLICY_RESULTS) == 2
+        assert reg.counter_value(
+            POLICY_RESULTS, policy_name='m', rule_name='r',
+            rule_result='fail', rule_type='Validation',
+            resource_kind='Pod', resource_namespace='d',
+            resource_request_operation='create') == 1
+        text = reg.render()
+        assert '# TYPE kyverno_policy_results_total counter' in text
+        assert 'rule_result="pass"' in text
+        assert 'kyverno_policy_execution_duration_seconds_bucket' in text
+
+    def test_disable(self):
+        reg = MetricsRegistry(disabled=[POLICY_RESULTS])
+        record_policy_results(reg, run_engine({}), 'CREATE')
+        assert reg.counter_total(POLICY_RESULTS) == 0
+
+
+class TestEvents:
+    def test_violation_events_created(self):
+        client = FakeClient()
+        gen = EventGenerator(client)
+        gen.run()
+        try:
+            events = events_for_response(run_engine({}))
+            assert len(events) == 1
+            assert events[0]['reason'] == 'PolicyViolation'
+            gen.add(*events)
+            gen.drain()
+            stored = client.list_resource('v1', 'Event', 'd', None)
+            assert len(stored) == 1
+            assert 'm/r fail' in stored[0]['message']
+        finally:
+            gen.stop()
+
+    def test_queue_bound(self):
+        client = FakeClient()
+        gen = EventGenerator(client, max_queued=2)
+        events = events_for_response(run_engine({}))
+        for _ in range(5):
+            gen.add(*events)
+        assert gen.dropped == 3
+
+
+class TestLogging:
+    def test_json_format(self, capsys):
+        from kyverno_tpu.observability.logging import (FORMAT_JSON, setup,
+                                                       with_values)
+        logger = setup(FORMAT_JSON, logging.INFO)
+        with_values(logger, 'applied policy', policy='m', rules=2)
+        err = capsys.readouterr().err.strip()
+        doc = json.loads(err.splitlines()[-1])
+        assert doc['msg'] == 'applied policy'
+        assert doc['policy'] == 'm' and doc['rules'] == 2

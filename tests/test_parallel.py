@@ -1,0 +1,120 @@
+"""Multi-device sharded evaluation on the virtual CPU mesh
+(conftest forces 8 host devices; VERDICT r1 item 7).
+
+Exercises mesh.distributed_scan_step from pytest: uneven shard sizes,
+batches alongside host-fallback policies, and the summary==histogram
+invariant that the psum reduction must satisfy."""
+
+import numpy as np
+import pytest
+import yaml
+
+import jax
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.ir import N_STATUS_CODES
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.parallel.mesh import (distributed_scan_step, make_mesh,
+                                       pad_to_multiple)
+
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: mesh-pack
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: no-latest
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: no latest
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+    - name: deny-default
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: not in default
+        deny:
+          conditions:
+            any:
+              - key: "{{request.object.metadata.namespace}}"
+                operator: Equals
+                value: default
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: host-only
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: needs-context
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      context:
+        - name: cm
+          configMap: {name: x, namespace: y}
+      validate:
+        message: m
+        deny: {conditions: {any: [{key: "{{cm.data.v}}", operator: Equals, value: x}]}}
+"""
+
+
+def pods(n):
+    return [{'apiVersion': 'v1', 'kind': 'Pod',
+             'metadata': {'name': f'p{i}',
+                          'namespace': 'default' if i % 3 else 'kube'},
+             'spec': {'containers': [
+                 {'name': 'c',
+                  'image': 'nginx:latest' if i % 2 else 'nginx:1.25'}]}}
+            for i in range(n)]
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+    return make_mesh(devices[:8])
+
+
+class TestDistributedScan:
+    def test_summary_matches_histogram(self, mesh):
+        policies = [Policy(d) for d in yaml.safe_load_all(PACK)]
+        cps = compile_policies(policies)
+        assert cps.host_rules  # host-fallback policy present in the set
+        resources = pods(24)
+        statuses, summary = distributed_scan_step(cps, mesh, resources)
+        assert statuses.shape == (24, len(cps.programs))
+        assert summary.shape == (len(cps.programs), N_STATUS_CODES)
+        expect = np.zeros_like(summary)
+        for j in range(statuses.shape[1]):
+            for s in range(N_STATUS_CODES):
+                expect[j, s] = int((statuses[:, j] == s).sum())
+        assert (summary == expect).all()
+
+    @pytest.mark.parametrize('n', [1, 7, 8, 9, 23])
+    def test_uneven_batches(self, mesh, n):
+        policies = [Policy(d) for d in yaml.safe_load_all(PACK)]
+        cps = compile_policies(policies)
+        statuses, summary = distributed_scan_step(cps, mesh, pods(n))
+        assert statuses.shape[0] == n
+        # padded rows must not pollute the summary
+        assert int(summary.sum()) == n * len(cps.programs)
+
+    def test_matches_single_device_scan(self, mesh):
+        policies = [Policy(d) for d in yaml.safe_load_all(PACK)]
+        resources = pods(13)
+        cps = compile_policies(policies)
+        statuses, _ = distributed_scan_step(cps, mesh, resources)
+        scanner = BatchScanner(policies)
+        single, _, _ = scanner.scan_statuses(resources)
+        assert (statuses == single).all()
+
+    def test_pad_to_multiple(self):
+        assert pad_to_multiple(13, 8) == 16
+        assert pad_to_multiple(16, 8) == 16
+        assert pad_to_multiple(1, 8) == 8

@@ -1,0 +1,128 @@
+"""Report controllers: metadata cache → batched background scan with
+last-scan-time resumability → aggregation into PolicyReports
+(reference: pkg/controllers/report)."""
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.dclient.client import FakeClient
+from kyverno_tpu.reports.aggregate import AggregateController
+from kyverno_tpu.reports.controllers import (ANNOTATION_LAST_SCAN_TIME,
+                                             AdmissionReportController,
+                                             BackgroundScanController,
+                                             MetadataCache,
+                                             ResourceController)
+
+POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: audit
+  rules:
+    - name: team-label
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: team label required
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+""")
+
+
+def pod(name, team=None, uid=None):
+    labels = {'team': team} if team else {}
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'uid': uid or f'uid-{name}', 'labels': labels},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def make_world():
+    client = FakeClient()
+    client.create_resource('kyverno.io/v1', 'ClusterPolicy', '', POLICY)
+    client.create_resource('v1', 'Pod', 'default', pod('good', team='infra'))
+    client.create_resource('v1', 'Pod', 'default', pod('bad'))
+    return client
+
+
+class TestScanPipeline:
+    def test_scan_writes_reports_and_aggregates(self):
+        client = make_world()
+        cache = MetadataCache()
+        resource_ctrl = ResourceController(client, cache)
+        scan_ctrl = BackgroundScanController(client, [Policy(POLICY)],
+                                             cache=cache)
+        resource_ctrl.update_policies([Policy(POLICY)])
+        for changed in resource_ctrl.sync():
+            scan_ctrl.enqueue(changed)
+        reports = scan_ctrl.reconcile()
+        assert len(reports) == 2
+        stored = client.list_resource('kyverno.io/v1alpha2',
+                                      'BackgroundScanReport', 'default', None)
+        assert len(stored) == 2
+        for r in stored:
+            assert ANNOTATION_LAST_SCAN_TIME in r['metadata']['annotations']
+        results = {r['metadata']['ownerReferences'][0]['name']:
+                   (r.get('results') or []) for r in stored}
+        assert results['good'][0]['result'] == 'pass'
+        assert results['bad'][0]['result'] == 'fail'
+        # aggregate → PolicyReport
+        agg = AggregateController(client)
+        agg.reconcile()
+        prs = client.list_resource('wgpolicyk8s.io/v1alpha2',
+                                   'PolicyReport', 'default', None)
+        assert prs
+        summary = prs[0].get('summary') or {}
+        assert summary.get('pass') == 1 and summary.get('fail') == 1
+
+    def test_last_scan_time_resumability(self):
+        client = make_world()
+        scan_ctrl = BackgroundScanController(client, [Policy(POLICY)])
+        p = pod('good', team='infra')
+        scan_ctrl.enqueue(p)
+        assert len(scan_ctrl.reconcile()) == 1
+        # unchanged resource: skipped
+        scan_ctrl.enqueue(p)
+        assert scan_ctrl.reconcile() == []
+        # changed resource: rescanned
+        p2 = pod('good')  # team label dropped
+        scan_ctrl.enqueue(p2)
+        assert len(scan_ctrl.reconcile()) == 1
+
+    def test_policy_change_invalidates_scans(self):
+        client = make_world()
+        scan_ctrl = BackgroundScanController(client, [Policy(POLICY)])
+        p = pod('good', team='infra')
+        scan_ctrl.enqueue(p)
+        scan_ctrl.reconcile()
+        scan_ctrl.set_policies([Policy(POLICY)])  # policy event
+        scan_ctrl.enqueue(p)
+        assert len(scan_ctrl.reconcile()) == 1  # re-scanned
+
+
+class TestAdmissionReportDedup:
+    def test_merges_by_uid(self):
+        client = FakeClient()
+        for i in range(3):
+            client.create_resource('kyverno.io/v1alpha2', 'AdmissionReport',
+                                   'default', {
+                'apiVersion': 'kyverno.io/v1alpha2',
+                'kind': 'AdmissionReport',
+                'metadata': {
+                    'name': f'rep-{i}', 'namespace': 'default',
+                    'creationTimestamp': f'2026-01-0{i+1}T00:00:00Z',
+                    'labels': {'audit.kyverno.io/resource.uid': 'u1'}},
+                'results': [{'policy': 'p', 'rule': f'r{i}',
+                             'result': 'pass', 'source': 'kyverno'}],
+            })
+        ctrl = AdmissionReportController(client)
+        assert ctrl.reconcile() == 1
+        left = client.list_resource('kyverno.io/v1alpha2',
+                                    'AdmissionReport', 'default', None)
+        assert len(left) == 1
+        assert len(left[0]['results']) == 3
+        assert left[0]['summary']['pass'] == 3
