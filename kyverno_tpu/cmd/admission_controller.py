@@ -1,0 +1,104 @@
+"""Admission-controller daemon (reference: cmd/kyverno/main.go:210).
+
+Wires cert renewal, the policy cache, the webhook server, and the
+leader-only reconcilers (webhook configurations, lease watchdog)."""
+
+from __future__ import annotations
+
+import tempfile
+from typing import List, Optional
+
+from ..api.policy import Policy
+from ..controllers.leaderelection import LeaderElector, mesh_is_leader
+from ..controllers.webhook import WebhookConfigReconciler
+from ..policycache.cache import Cache
+from ..tls.certs import CertRenewer
+from ..webhooks.handlers import ResourceHandlers
+from ..webhooks.server import WebhookServer
+from .internal import Setup, base_parser
+
+
+class AdmissionController:
+    def __init__(self, setup: Setup, port: int = 9443, tls: bool = True):
+        self.setup = setup
+        self.cache = Cache()
+        self.cert_renewer = CertRenewer(setup.client,
+                                        setup.options.namespace)
+        # the CA/pair secrets are always provisioned — webhook configs
+        # need the CA bundle even when serving plain HTTP in tests
+        _ca, cert, key = self.cert_renewer.renew()
+        certfile = keyfile = None
+        if tls:
+            self._cert_tmp = tempfile.NamedTemporaryFile(suffix='.crt')
+            self._key_tmp = tempfile.NamedTemporaryFile(suffix='.key')
+            self._cert_tmp.write(cert)
+            self._cert_tmp.flush()
+            self._key_tmp.write(key)
+            self._key_tmp.flush()
+            certfile, keyfile = self._cert_tmp.name, self._key_tmp.name
+        self.handlers = ResourceHandlers(
+            self.cache, configuration=setup.configuration,
+            ur_sink=self._create_ur)
+        self.server = WebhookServer(
+            self.handlers, configuration=setup.configuration,
+            port=port, certfile=certfile, keyfile=keyfile)
+        self.reconciler = WebhookConfigReconciler(
+            setup.client, self.cert_renewer.ca_bundle(),
+            setup.options.namespace)
+        self.elector = None
+        if setup.options.leader_election:
+            self.elector = LeaderElector(setup.client, 'kyverno',
+                                         setup.options.namespace)
+
+    def _create_ur(self, ur_spec: dict) -> None:
+        from ..background.updaterequest import UpdateRequestGenerator
+        UpdateRequestGenerator(self.setup.client).apply(
+            dict(ur_spec, requestType=ur_spec.get('type', 'generate')))
+
+    def sync_policies(self) -> List[Policy]:
+        """Refresh the cache from stored Policy CRs (informer-driven in
+        the reference: pkg/controllers/policycache/controller.go:133)."""
+        docs = []
+        for kind in ('ClusterPolicy', 'Policy'):
+            try:
+                docs += self.setup.client.list_resource(
+                    'kyverno.io/v1', kind, '', None)
+            except Exception:  # noqa: BLE001
+                continue
+        policies = [Policy(d) for d in docs]
+        self.cache.warm_up(policies)
+        return policies
+
+    def tick(self) -> None:
+        policies = self.sync_policies()
+        is_leader = mesh_is_leader() and (
+            self.elector is None or self.elector.is_leader())
+        if is_leader:
+            self.reconciler.reconcile(policies)
+            self.reconciler.heartbeat()
+
+    def run(self) -> None:
+        if self.elector is not None:
+            self.elector.run()
+        self.server.start()
+        self.setup.install_signal_handlers()
+        self.setup.run_until_stopped(self.tick, interval=5.0)
+        self.server.stop()
+        if self.elector is not None:
+            self.elector.release()
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    parser = base_parser('kyverno-admission-controller')
+    parser.add_argument('--port', type=int, default=9443)
+    parser.add_argument('--insecure', action='store_true',
+                        help='serve plain HTTP (tests/dev)')
+    setup = Setup('kyverno-admission-controller', args, parser)
+    controller = AdmissionController(setup, port=setup.options.port,
+                                     tls=not setup.options.insecure)
+    controller.run()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

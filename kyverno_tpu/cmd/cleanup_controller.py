@@ -1,0 +1,43 @@
+"""Cleanup-controller daemon (reference: cmd/cleanup-controller/main.go):
+evaluates CleanupPolicy schedules and deletes matching resources."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..controllers.cleanup import CleanupController
+from ..controllers.leaderelection import mesh_is_leader
+from .internal import Setup, base_parser
+
+
+class CleanupDaemon:
+    def __init__(self, setup: Setup):
+        self.setup = setup
+        self.controller = CleanupController(setup.client)
+
+    def tick(self) -> None:
+        if not mesh_is_leader():
+            return
+        for kind in ('ClusterCleanupPolicy', 'CleanupPolicy'):
+            try:
+                for doc in self.setup.client.list_resource(
+                        'kyverno.io/v2alpha1', kind, '', None):
+                    self.controller.set_policy(doc)
+            except Exception:  # noqa: BLE001
+                continue
+        self.controller.tick()
+
+    def run(self) -> None:
+        self.setup.install_signal_handlers()
+        self.setup.run_until_stopped(self.tick, interval=10.0)
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    setup = Setup('kyverno-cleanup-controller', args,
+                  base_parser('kyverno-cleanup-controller'))
+    CleanupDaemon(setup).run()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

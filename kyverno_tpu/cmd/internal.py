@@ -1,0 +1,74 @@
+"""Shared process bootstrap for the deployable binaries.
+
+Mirrors the reference's setup sequence — logging → flags → maxprocs →
+profiling → signal handling → metrics (reference: cmd/internal/setup.go:21
+Setup, flag registry cmd/internal/flag.go:35-63).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from typing import Callable, List, Optional
+
+from ..config.config import Configuration
+from ..observability.logging import FORMAT_TEXT, setup as setup_logging
+from ..observability.metrics import MetricsRegistry
+
+
+def base_parser(name: str) -> argparse.ArgumentParser:
+    """reference: cmd/internal/flag.go:35-63"""
+    p = argparse.ArgumentParser(prog=name)
+    p.add_argument('--logging-format', default=FORMAT_TEXT,
+                   choices=('text', 'json'))
+    p.add_argument('--log-level', default='info',
+                   choices=('debug', 'info', 'warning', 'error'))
+    p.add_argument('--namespace', default='kyverno')
+    p.add_argument('--metrics-port', type=int, default=8000)
+    p.add_argument('--disable-metrics', action='store_true')
+    p.add_argument('--leader-election', action='store_true')
+    p.add_argument('--kubeconfig', default='',
+                   help='unused with the in-memory client; reserved for '
+                        'a real cluster transport')
+    return p
+
+
+class Setup:
+    """Process-wide wiring shared by every binary."""
+
+    def __init__(self, name: str, args: Optional[List[str]] = None,
+                 parser: Optional[argparse.ArgumentParser] = None,
+                 client=None):
+        parser = parser or base_parser(name)
+        self.options = parser.parse_args(args)
+        self.logger = setup_logging(
+            self.options.logging_format,
+            getattr(logging, self.options.log_level.upper()))
+        self.metrics = MetricsRegistry() if not self.options.disable_metrics \
+            else MetricsRegistry(disabled=['*'])
+        self.configuration = Configuration()
+        if client is None:
+            from ..dclient.client import FakeClient
+            client = FakeClient()
+        self.client = client
+        self.stop_event = threading.Event()
+
+    def install_signal_handlers(self) -> None:
+        def handler(signum, frame):
+            self.logger.info('shutting down (signal %s)', signum)
+            self.stop_event.set()
+        try:
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:
+            pass  # not the main thread (tests)
+
+    def run_until_stopped(self, tick: Callable[[], None],
+                          interval: float = 1.0) -> None:
+        while not self.stop_event.wait(interval):
+            try:
+                tick()
+            except Exception:  # noqa: BLE001
+                self.logger.exception('controller tick failed')

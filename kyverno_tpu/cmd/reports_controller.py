@@ -1,0 +1,70 @@
+"""Reports-controller daemon (reference: cmd/reports-controller/main.go)
+— the batch-scan path the TPU backend accelerates: resource metadata
+sync → device-batched background scan → admission-report dedup →
+PolicyReport aggregation."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api.policy import Policy
+from ..controllers.leaderelection import mesh_is_leader
+from ..reports.aggregate import AggregateController
+from ..reports.controllers import (AdmissionReportController,
+                                   BackgroundScanController, MetadataCache,
+                                   ResourceController)
+from .internal import Setup, base_parser
+
+
+class ReportsController:
+    def __init__(self, setup: Setup):
+        self.setup = setup
+        self.cache = MetadataCache()
+        self.resource_controller = ResourceController(setup.client,
+                                                      self.cache)
+        self.scan_controller = BackgroundScanController(
+            setup.client, [], cache=self.cache)
+        self.admission_controller = AdmissionReportController(setup.client)
+        self.aggregate_controller = AggregateController(setup.client)
+        self._policy_snapshot = None
+
+    def _policies(self) -> List[Policy]:
+        docs = []
+        for kind in ('ClusterPolicy', 'Policy'):
+            try:
+                docs += self.setup.client.list_resource(
+                    'kyverno.io/v1', kind, '', None)
+            except Exception:  # noqa: BLE001
+                continue
+        return [Policy(d) for d in docs]
+
+    def tick(self) -> None:
+        if not mesh_is_leader():
+            return
+        policies = self._policies()
+        snapshot = [p.raw for p in policies]
+        if snapshot != self._policy_snapshot:
+            self._policy_snapshot = snapshot
+            self.resource_controller.update_policies(policies)
+            self.scan_controller.set_policies(policies)
+            self.scan_controller.enqueue_all()
+        for changed in self.resource_controller.sync():
+            self.scan_controller.enqueue(changed)
+        self.scan_controller.reconcile()
+        self.admission_controller.reconcile()
+        self.aggregate_controller.reconcile()
+
+    def run(self) -> None:
+        self.setup.install_signal_handlers()
+        self.setup.run_until_stopped(self.tick, interval=2.0)
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    setup = Setup('kyverno-reports-controller', args,
+                  base_parser('kyverno-reports-controller'))
+    ReportsController(setup).run()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,0 +1,155 @@
+"""Cleanup controller: CleanupPolicy / ClusterCleanupPolicy execution.
+
+The reference reconciles a CronJob per cleanup policy whose schedule
+POSTs back to the cleanup webhook, which deletes the matching resources
+(reference: pkg/controllers/cleanup/controller.go:164 buildCronJob,
+cmd/cleanup-controller/handlers/cleanup/handlers.go).  Here the cron
+schedule is evaluated in-process: ``tick(now)`` runs every due policy's
+deletion pass — the same match + conditions semantics — against the
+dynamic client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.policy import Policy, Rule
+from ..api.unstructured import Resource
+from ..engine import operators
+from ..engine.api import PolicyContext
+from ..engine.context import Context
+from ..engine.match import matches_resource_description
+from ..engine.variables import substitute_all
+
+
+def parse_cron(expr: str) -> Tuple[set, set, set, set, set]:
+    """Standard 5-field cron (minute hour dom month dow)."""
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f'invalid cron expression {expr!r}')
+    ranges = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+    out = []
+    for field, (lo, hi) in zip(fields, ranges):
+        vals = set()
+        for part in field.split(','):
+            step = 1
+            if '/' in part:
+                part, step_s = part.split('/', 1)
+                step = int(step_s)
+            if part == '*':
+                start, end = lo, hi
+            elif '-' in part:
+                start_s, end_s = part.split('-', 1)
+                start, end = int(start_s), int(end_s)
+            else:
+                start = end = int(part)
+            vals.update(range(start, end + 1, step))
+        out.append(vals)
+    return tuple(out)
+
+
+def cron_matches(expr: str, ts: float) -> bool:
+    minute, hour, dom, month, dow = parse_cron(expr)
+    t = time.gmtime(ts)
+    return (t.tm_min in minute and t.tm_hour in hour and
+            t.tm_mday in dom and t.tm_mon in month and
+            (t.tm_wday + 1) % 7 in dow)
+
+
+class CleanupController:
+    """reference: pkg/controllers/cleanup/controller.go +
+    cmd/cleanup-controller/handlers/cleanup"""
+
+    def __init__(self, client):
+        self.client = client
+        self._lock = threading.Lock()
+        self._policies: Dict[str, dict] = {}
+        self._last_run: Dict[str, int] = {}
+
+    def set_policy(self, doc: dict) -> None:
+        key = self._key(doc)
+        with self._lock:
+            self._policies[key] = doc
+
+    def delete_policy(self, doc: dict) -> None:
+        with self._lock:
+            self._policies.pop(self._key(doc), None)
+
+    @staticmethod
+    def _key(doc: dict) -> str:
+        meta = doc.get('metadata') or {}
+        ns = meta.get('namespace', '')
+        return f"{ns}/{meta.get('name', '')}" if ns else meta.get('name', '')
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Run every policy whose schedule matches the current minute;
+        returns the deleted resources."""
+        now = now or time.time()
+        minute = int(now // 60)
+        deleted: List[dict] = []
+        with self._lock:
+            policies = dict(self._policies)
+        for key, doc in policies.items():
+            schedule = (doc.get('spec') or {}).get('schedule', '')
+            if not schedule:
+                continue
+            if self._last_run.get(key) == minute:
+                continue
+            try:
+                due = cron_matches(schedule, now)
+            except ValueError:
+                continue
+            if not due:
+                continue
+            self._last_run[key] = minute
+            deleted.extend(self.cleanup(doc))
+        return deleted
+
+    def cleanup(self, doc: dict) -> List[dict]:
+        """One deletion pass for a cleanup policy
+        (reference: handlers/cleanup/handlers.go executePolicy)."""
+        spec = doc.get('spec') or {}
+        meta = doc.get('metadata') or {}
+        policy_ns = meta.get('namespace', '')
+        match = spec.get('match') or {}
+        exclude = spec.get('exclude') or {}
+        conditions = spec.get('conditions')
+        rule = Rule({'name': 'cleanup', 'match': match, 'exclude': exclude})
+        kinds = set()
+        for f in [match] + (match.get('any') or []) + \
+                (match.get('all') or []):
+            for k in (f.get('resources') or {}).get('kinds') or []:
+                kinds.add(str(k).split('/')[-1])
+        deleted = []
+        for kind in sorted(kinds):
+            try:
+                items = self.client.list_resource('', kind, policy_ns, None)
+            except Exception:  # noqa: BLE001
+                continue
+            for item in items:
+                r = Resource(item)
+                if matches_resource_description(
+                        r, rule, None, [], {}, '') is not None:
+                    continue
+                if conditions is not None and \
+                        not self._conditions_met(conditions, item):
+                    continue
+                try:
+                    self.client.delete_resource(
+                        item.get('apiVersion', ''), r.kind,
+                        r.namespace, r.name)
+                    deleted.append(item)
+                except Exception:  # noqa: BLE001
+                    continue
+        return deleted
+
+    def _conditions_met(self, conditions: Any, resource: dict) -> bool:
+        ctx = Context()
+        ctx.add_resource(resource)
+        try:
+            substituted = substitute_all(ctx, conditions)
+        except Exception:  # noqa: BLE001
+            return False
+        return operators.evaluate_conditions(ctx, substituted)

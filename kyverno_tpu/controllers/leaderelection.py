@@ -1,0 +1,128 @@
+"""Leader election.
+
+Two modes, mirroring SURVEY §2.6's mapping of the reference's
+client-go lease election (reference: pkg/leaderelection/
+leaderelection.go:51 New):
+
+* **Lease mode** — lease CRs through the dynamic client, for running
+  multiple replicas against a shared API server like the reference.
+* **Mesh mode** — under ``jax.distributed`` the leader is process 0 of
+  the initialized process group: a single deterministic leader per
+  slice with no extra coordination traffic (the TPU-native equivalent
+  of one elected replica driving the reconcilers).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+LEASE_DURATION = 15.0   # reference: leaderelection.go LeaseDuration
+RENEW_DEADLINE = 10.0
+RETRY_PERIOD = 2.0
+
+
+def mesh_is_leader() -> bool:
+    """Process 0 of the jax.distributed group leads (single-process
+    setups are trivially the leader)."""
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 - jax not initialized → standalone
+        return True
+
+
+class LeaderElector:
+    """Lease-based election over the dynamic client."""
+
+    def __init__(self, client, name: str, namespace: str = 'kyverno',
+                 identity: Optional[str] = None,
+                 on_started: Optional[Callable[[], None]] = None,
+                 on_stopped: Optional[Callable[[], None]] = None):
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f'kyverno-{uuid.uuid4().hex[:8]}'
+        self.on_started = on_started
+        self.on_stopped = on_stopped
+        self._leading = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """One acquire/renew attempt; returns leadership state."""
+        now = now or time.time()
+        lease = None
+        try:
+            lease = self.client.get_resource(
+                'coordination.k8s.io/v1', 'Lease', self.namespace,
+                self.name)
+        except Exception:  # noqa: BLE001
+            lease = None
+        if lease is None:
+            self.client.create_resource(
+                'coordination.k8s.io/v1', 'Lease', self.namespace, {
+                    'apiVersion': 'coordination.k8s.io/v1', 'kind': 'Lease',
+                    'metadata': {'name': self.name,
+                                 'namespace': self.namespace},
+                    'spec': {'holderIdentity': self.identity,
+                             'renewTime': now,
+                             'leaseDurationSeconds': int(LEASE_DURATION)}})
+            self._set_leading(True)
+            return True
+        spec = lease.setdefault('spec', {})
+        holder = spec.get('holderIdentity', '')
+        renew = float(spec.get('renewTime') or 0)
+        expired = now - renew > LEASE_DURATION
+        if holder == self.identity or expired or not holder:
+            spec['holderIdentity'] = self.identity
+            spec['renewTime'] = now
+            self.client.update_resource(
+                'coordination.k8s.io/v1', 'Lease', self.namespace, lease)
+            self._set_leading(True)
+            return True
+        self._set_leading(False)
+        return False
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading and not self._leading and self.on_started is not None:
+            self.on_started()
+        if not leading and self._leading and self.on_stopped is not None:
+            self.on_stopped()
+        self._leading = leading
+
+    def run(self) -> None:
+        def loop():
+            while not self._stop.wait(RETRY_PERIOD):
+                try:
+                    self.try_acquire()
+                except Exception:  # noqa: BLE001
+                    self._set_leading(False)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def release(self) -> None:
+        """Graceful shutdown releases the lease
+        (reference: pkg/webhooks/server.go:213 cleanup)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._leading:
+            try:
+                lease = self.client.get_resource(
+                    'coordination.k8s.io/v1', 'Lease', self.namespace,
+                    self.name)
+                if (lease.get('spec') or {}).get(
+                        'holderIdentity') == self.identity:
+                    lease['spec']['holderIdentity'] = ''
+                    self.client.update_resource(
+                        'coordination.k8s.io/v1', 'Lease', self.namespace,
+                        lease)
+            except Exception:  # noqa: BLE001
+                pass
+        self._set_leading(False)

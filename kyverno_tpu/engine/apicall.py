@@ -49,7 +49,7 @@ def default_http_transport(method: str, url: str, headers: dict,
 def default_token_reader() -> str:
     try:
         with open(TOKEN_PATH) as f:
-            return f.read()
+            return f.read().strip()
     except OSError:
         return ''
 
@@ -120,10 +120,11 @@ class APICallExecutor:
         except ValueError as e:
             raise ContextError(
                 f'failed to parse JSON response for APICall {name}: {e}')
-        jmespath = call.get('jmesPath', '')
-        if not jmespath:
+        # the whole apiCall dict was already variable-substituted in
+        # __call__; the path is final here
+        path = call.get('jmesPath', '')
+        if not path:
             return parsed
-        path = vars_mod.substitute_all(ctx, jmespath)
         try:
             result = jp_compile(str(path)).search(parsed)
         except Exception as e:  # noqa: BLE001
@@ -172,17 +173,25 @@ def fetch_image_data(entry: dict, ctx: Context, rclient) -> Any:
             f'invalid image reference {ref}, image reference must be '
             f'a string')
     path = vars_mod.substitute_all(ctx, spec.get('jmesPath', '') or '')
-    desc = rclient.fetch_image_descriptor(ref)
+    try:
+        desc = rclient.fetch_image_descriptor(ref)
+    except Exception as e:  # noqa: BLE001 - registry failure → rule error
+        raise ContextError(
+            f'failed to fetch image descriptor for {ref}: {e}')
     try:
         info = get_image_info(ref)
     except ValueError as e:
         raise ContextError(str(e))
     manifest = {}
     config_data = {}
-    if hasattr(rclient, 'get_manifest'):
-        manifest = rclient.get_manifest(ref)
-    if hasattr(rclient, 'get_config'):
-        config_data = rclient.get_config(ref)
+    try:
+        if hasattr(rclient, 'get_manifest'):
+            manifest = rclient.get_manifest(ref)
+        if hasattr(rclient, 'get_config'):
+            config_data = rclient.get_config(ref)
+    except Exception as e:  # noqa: BLE001
+        raise ContextError(
+            f'failed to fetch image metadata for {ref}: {e}')
     repo_name = f'{info.registry}/{info.path}' if info.registry \
         else info.path
     data = {

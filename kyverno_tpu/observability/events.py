@@ -109,7 +109,7 @@ class EventGenerator:
 
     def drain(self, timeout: float = 5.0) -> None:
         deadline = time.time() + timeout
-        while not self._queue.empty() and time.time() < deadline:
+        while self._queue.unfinished_tasks and time.time() < deadline:
             time.sleep(0.01)
 
     def stop(self) -> None:

@@ -24,7 +24,6 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, Dict[Tuple, float]] = {}
         self._hists: Dict[str, Dict[Tuple, List]] = {}
-        self._label_names: Dict[str, Tuple[str, ...]] = {}
         self._disabled = set(disabled or [])
 
     def configure(self, disabled: List[str]) -> None:
@@ -36,8 +35,6 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
-            self._label_names.setdefault(
-                name, tuple(k for k, _ in key))
             series = self._counters.setdefault(name, {})
             series[key] = series.get(key, 0.0) + amount
 
@@ -46,8 +43,6 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
-            self._label_names.setdefault(
-                name, tuple(k for k, _ in key))
             series = self._hists.setdefault(name, {})
             entry = series.get(key)
             if entry is None:
@@ -82,12 +77,11 @@ class MetricsRegistry:
                 out.append(f'# TYPE {name} histogram')
                 for key, (count, total, buckets) in sorted(
                         self._hists[name].items()):
-                    cum = 0
+                    # observe() already stores cumulative bucket counts
                     for bound, b in zip(_DEFAULT_BUCKETS, buckets):
-                        cum += b
                         lk = key + (('le', _fmt(bound)),)
                         out.append(
-                            f'{name}_bucket{_fmt_labels(lk)} {cum}')
+                            f'{name}_bucket{_fmt_labels(lk)} {b}')
                     lk = key + (('le', '+Inf'),)
                     out.append(f'{name}_bucket{_fmt_labels(lk)} {count}')
                     out.append(f'{name}_sum{_fmt_labels(key)} '

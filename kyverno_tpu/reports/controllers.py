@@ -234,6 +234,8 @@ class AdmissionReportController:
             for report in reports:
                 labels = (report.get('metadata') or {}).get('labels') or {}
                 uid = labels.get('audit.kyverno.io/resource.uid', '')
+                if not uid:
+                    continue  # unlabeled reports are not dedup candidates
                 by_uid.setdefault(uid, []).append(report)
             for uid, group in by_uid.items():
                 if len(group) <= 1:

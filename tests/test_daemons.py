@@ -1,0 +1,204 @@
+"""End-to-end daemon wiring: the five deployables sharing one fake
+cluster — admission webhook → UpdateRequest → background controller →
+generated resource; reports controller → PolicyReport; cert renewal,
+webhook config reconciliation, cleanup, init
+(reference: cmd/*)."""
+
+import json
+
+import yaml
+
+from kyverno_tpu.cmd.admission_controller import AdmissionController
+from kyverno_tpu.cmd.background_controller import BackgroundController
+from kyverno_tpu.cmd.cleanup_controller import CleanupDaemon
+from kyverno_tpu.cmd.init import cleanup_stale_state
+from kyverno_tpu.cmd.internal import Setup, base_parser
+from kyverno_tpu.cmd.reports_controller import ReportsController
+from kyverno_tpu.dclient.client import FakeClient
+
+GENERATE_POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: add-np}
+spec:
+  rules:
+    - name: default-deny
+      match: {any: [{resources: {kinds: [Namespace]}}]}
+      generate:
+        apiVersion: networking.k8s.io/v1
+        kind: NetworkPolicy
+        name: default-deny
+        namespace: "{{request.object.metadata.name}}"
+        data:
+          spec: {podSelector: {}, policyTypes: [Ingress]}
+""")
+
+AUDIT_POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: need-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: audit
+  rules:
+    - name: team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: team required
+        pattern: {metadata: {labels: {team: "?*"}}}
+""")
+
+CLEANUP_POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v2alpha1
+kind: ClusterCleanupPolicy
+metadata: {name: sweep-temp}
+spec:
+  schedule: "* * * * *"
+  match: {any: [{resources: {kinds: [ConfigMap]}}]}
+  conditions:
+    all:
+      - key: "{{request.object.metadata.labels.temp}}"
+        operator: Equals
+        value: "true"
+""")
+
+
+def make_setup(client=None):
+    return Setup('test', [], base_parser('test'), client=client)
+
+
+def review(resource):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': 'u1', 'operation': 'CREATE',
+            'kind': {'group': '', 'version': 'v1',
+                     'kind': resource.get('kind', '')},
+            'namespace': (resource.get('metadata') or {}).get(
+                'namespace', ''),
+            'object': resource, 'userInfo': {'username': 'test'},
+        }}).encode()
+
+
+class TestAdmissionToGenerate:
+    def test_full_generate_flow(self):
+        client = FakeClient()
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               GENERATE_POLICY)
+        setup = make_setup(client)
+        admission = AdmissionController(setup, tls=False)
+        admission.tick()  # sync cache + reconcile webhook configs
+
+        # webhook configurations materialized with CA bundle
+        vwc = client.get_resource(
+            'admissionregistration.k8s.io/v1',
+            'ValidatingWebhookConfiguration', '',
+            'kyverno-resource-validating-webhook-cfg')
+        assert vwc['webhooks']
+        assert vwc['webhooks'][0]['clientConfig']['caBundle']
+
+        # admission of a Namespace spawns an UpdateRequest
+        ns = {'apiVersion': 'v1', 'kind': 'Namespace',
+              'metadata': {'name': 'team-a'}}
+        body = admission.server.handle('/validate', review(ns))
+        assert json.loads(body)['response']['allowed'] is True
+        client.create_resource('v1', 'Namespace', '', ns)
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest',
+                                   'kyverno', None)
+        assert len(urs) == 1
+
+        # the background controller drains the UR into the generated
+        # resource
+        bg = BackgroundController(setup)
+        bg.tick()
+        nps = client.list_resource('networking.k8s.io/v1', 'NetworkPolicy',
+                                   'team-a', None)
+        assert len(nps) == 1
+        assert nps[0]['metadata']['name'] == 'default-deny'
+
+
+class TestReportsDaemon:
+    def test_scan_to_policy_report(self):
+        client = FakeClient()
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               AUDIT_POLICY)
+        client.create_resource('v1', 'Pod', 'default', {
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p1', 'namespace': 'default',
+                         'uid': 'u-p1', 'labels': {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'x'}]}})
+        setup = make_setup(client)
+        reports = ReportsController(setup)
+        reports.tick()
+        prs = client.list_resource('wgpolicyk8s.io/v1alpha2',
+                                   'PolicyReport', 'default', None)
+        assert prs and prs[0]['summary']['fail'] == 1
+
+
+class TestCleanupDaemon:
+    def test_cleanup_deletes_matching(self):
+        client = FakeClient()
+        client.create_resource('kyverno.io/v2alpha1',
+                               'ClusterCleanupPolicy', '', CLEANUP_POLICY)
+        client.create_resource('v1', 'ConfigMap', 'default', {
+            'apiVersion': 'v1', 'kind': 'ConfigMap',
+            'metadata': {'name': 'tmp', 'namespace': 'default',
+                         'labels': {'temp': 'true'}}})
+        client.create_resource('v1', 'ConfigMap', 'default', {
+            'apiVersion': 'v1', 'kind': 'ConfigMap',
+            'metadata': {'name': 'keep', 'namespace': 'default'}})
+        daemon = CleanupDaemon(make_setup(client))
+        daemon.tick()  # "* * * * *" matches every minute
+        names = [c['metadata']['name'] for c in client.list_resource(
+            'v1', 'ConfigMap', 'default', None)]
+        assert names == ['keep']
+
+
+class TestInitJob:
+    def test_removes_stale_state(self):
+        client = FakeClient()
+        setup = make_setup(client)
+        admission = AdmissionController(setup, tls=False)
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               AUDIT_POLICY)
+        admission.tick()
+        admission.reconciler.heartbeat()
+        assert cleanup_stale_state(client) >= 2
+        leases = client.list_resource('coordination.k8s.io/v1', 'Lease',
+                                      'kyverno', None)
+        assert leases == []
+
+
+class TestCertRenewal:
+    def test_ca_and_pair_secrets(self):
+        import datetime
+        from kyverno_tpu.tls.certs import (CA_SECRET, TLS_SECRET,
+                                           CertRenewer, cert_expiry)
+        client = FakeClient()
+        renewer = CertRenewer(client)
+        ca1, cert1, _ = renewer.renew()
+        assert client.get_resource('v1', 'Secret', 'kyverno', CA_SECRET)
+        assert client.get_resource('v1', 'Secret', 'kyverno', TLS_SECRET)
+        # stable while valid
+        ca2, cert2, _ = renewer.renew()
+        assert ca1 == ca2 and cert1 == cert2
+        # pair rotates inside the renewal window
+        near_expiry = cert_expiry(cert1) - datetime.timedelta(days=1)
+        _, cert3, _ = renewer.renew(now=near_expiry)
+        assert cert3 != cert1
+
+
+class TestLeaderElection:
+    def test_lease_handover(self):
+        from kyverno_tpu.controllers.leaderelection import LeaderElector
+        client = FakeClient()
+        a = LeaderElector(client, 'test-lease', identity='a')
+        b = LeaderElector(client, 'test-lease', identity='b')
+        assert a.try_acquire(now=100.0) is True
+        assert b.try_acquire(now=101.0) is False
+        # expiry hands over
+        assert b.try_acquire(now=200.0) is True
+        assert a.try_acquire(now=201.0) is False
+        b.release()
+        assert a.try_acquire(now=202.0) is True
