@@ -589,7 +589,11 @@ def _measure_elems(resources: List[dict], containers: List[Tuple]) -> int:
 
 
 def encode_batch(resources: List[dict], cps: CompiledPolicySet,
-                 padded_n: int = 0) -> Batch:
+                 padded_n: int = 0,
+                 contexts: Optional[List[dict]] = None) -> Batch:
+    """``contexts`` overrides the per-resource gather context (admission
+    scans thread operation/userInfo/oldObject through; defaults to the
+    background-scan context {'request': {'object': doc}})."""
     n = max(len(resources), padded_n)
     batch = Batch(n)
     slot_needs, gather_needs, elem_needs, array_paths = _needs_cached(cps)
@@ -606,8 +610,11 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
     # stripped; engine/context.py:36 merge_patch) — a variable resolving
     # to an explicit null must raise NotFound exactly like the host
     from ..engine.context import merge_patch
-    bases = [merge_patch({}, {'request': {'object': doc}})
-             for doc in resources]
+    if contexts is not None:
+        bases = [merge_patch({}, c) for c in contexts]
+    else:
+        bases = [merge_patch({}, {'request': {'object': doc}})
+                 for doc in resources]
     gather_results = {
         g: [_run_gather_ctx(searcher, base) for base in bases]
         for g, searcher in ((g, _gather_searcher(g)) for g in cps.gathers)}

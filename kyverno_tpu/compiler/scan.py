@@ -103,40 +103,48 @@ class BatchScanner:
             return True
         return bool(res.namespace) and res.namespace == policy.namespace
 
-    def _match_one(self, j: int, res: Resource) -> bool:
+    def _match_one(self, j: int, res: Resource,
+                   admission: Optional[tuple] = None) -> bool:
         prog = self.cps.programs[j]
         policy = self.policies[prog.policy_index]
         if not self._policy_gate(policy, res):
             return False
+        info, roles, ns_labels = admission or (None, [], {})
         return matches_resource_description(
-            res, self._rules[j], None, [], {}, '') is None
+            res, self._rules[j], info, roles, ns_labels, '') is None
 
-    def match_matrix(self, resources: List[dict],
-                     wrapped: List[Resource]) -> np.ndarray:
-        """[R, P] bool match mask, group-cached for simple-match rules."""
+    def match_matrix(self, resources: List[dict], wrapped: List[Resource],
+                     admission: Optional[tuple] = None) -> np.ndarray:
+        """[R, P] bool match mask, group-cached for simple-match rules.
+        ``admission`` carries (admission_info, exclude_group_roles,
+        namespace_labels, operation) for webhook scans; simple-match
+        rules only reference kinds/namespaces/operations, so the group
+        cache stays valid with the operation folded into the key."""
         n = len(resources)
         p = len(self.cps.programs)
         match = np.zeros((n, p), bool)
         if p == 0:
             return match
         simple = np.asarray(self._simple_match)
-        # group resources by (kind, apiVersion, namespace)
-        groups: Dict[Tuple[str, str, str], List[int]] = {}
+        operation = admission[3] if admission else ''
+        adm3 = admission[:3] if admission else None
+        # group resources by (kind, apiVersion, namespace, operation)
+        groups: Dict[Tuple, List[int]] = {}
         for i, doc in enumerate(resources):
-            groups.setdefault(_group_key(doc), []).append(i)
+            groups.setdefault(_group_key(doc) + (operation,), []).append(i)
         for key, idxs in groups.items():
             cached = self._match_cache.get(key)
             if cached is None:
                 rep = wrapped[idxs[0]]
                 cached = np.array([
-                    self._match_one(j, rep) if simple[j] else False
+                    self._match_one(j, rep, adm3) if simple[j] else False
                     for j in range(p)])
                 self._match_cache[key] = cached
             match[idxs, :] = cached
         # non-simple rules: evaluate per resource
         for j in np.nonzero(~simple)[0]:
             for i in range(n):
-                match[i, j] = self._match_one(int(j), wrapped[i])
+                match[i, j] = self._match_one(int(j), wrapped[i], adm3)
         return match
 
     # -- device evaluation --------------------------------------------------
@@ -144,8 +152,23 @@ class BatchScanner:
     #: fixed device-chunk size: XLA compiles the evaluator once per
     #: distinct batch shape, so large scans stream fixed-size chunks
     CHUNK = int(__import__('os').environ.get('KTPU_SCAN_CHUNK', '8192'))
+    #: batches at or below this size run on the host-local CPU backend:
+    #: a single admission request must not pay a remote-accelerator
+    #: round trip (latency floor), while bulk scans amortize it
+    SMALL_BATCH = int(__import__('os').environ.get(
+        'KTPU_SMALL_BATCH', '64'))
 
-    def _device_statuses(self, resources: List[dict]):
+    def _small_device(self):
+        import jax
+        try:
+            if jax.default_backend() != 'cpu':
+                return jax.local_devices(backend='cpu')[0]
+        except Exception:  # noqa: BLE001 - no cpu backend registered
+            return None
+        return None
+
+    def _device_statuses(self, resources: List[dict],
+                         contexts: Optional[List[dict]] = None):
         if not self.cps.programs or not resources:
             z = np.zeros((len(resources), len(self.cps.programs)), np.int8)
             return z, z
@@ -155,12 +178,18 @@ class BatchScanner:
         pending = []
         for start in range(0, n, chunk):
             part = resources[start:start + chunk]
+            part_ctx = contexts[start:start + chunk] \
+                if contexts is not None else None
             # bucketed padding: power-of-two buckets below one chunk,
             # exactly CHUNK otherwise → a handful of compiled shapes total
             bucket = chunk if n > chunk else \
                 max(64, 1 << (len(part) - 1).bit_length())
-            batch = encode_batch(part, self.cps, padded_n=bucket)
-            tensors, layout = shard_batch(batch.tensors(), self.mesh)
+            batch = encode_batch(part, self.cps, padded_n=bucket,
+                                 contexts=part_ctx)
+            small = self.mesh is None and n <= self.SMALL_BATCH
+            device = self._small_device() if small else None
+            tensors, layout = shard_batch(batch.tensors(), self.mesh,
+                                          device=device)
             # dispatch is async: the device evaluates this chunk while the
             # host encodes the next one (the jax default double-buffering)
             s, d = self._evaluator(tensors, layout)
@@ -182,20 +211,36 @@ class BatchScanner:
 
     # -- full responses -----------------------------------------------------
 
-    def scan(self, resources: List[dict]) -> List[List[EngineResponse]]:
+    def scan(self, resources: List[dict],
+             contexts: Optional[List[dict]] = None,
+             admission: Optional[tuple] = None,
+             pctx_factory=None) -> List[List[EngineResponse]]:
         """Return, per resource, the engine responses of all policies with
-        at least one applicable rule (host-identical)."""
+        at least one applicable rule (host-identical).
+
+        Webhook scans pass ``contexts`` (the admission JSON context per
+        resource), ``admission`` (admission_info, exclude_group_roles,
+        namespace_labels, operation) for match semantics, and
+        ``pctx_factory(doc)`` so host materialization sees the same
+        PolicyContext the engine loop would build."""
         n = len(resources)
         if n == 0:
             return []
+        self._pctx_factory = pctx_factory
+        # admission scans evaluate every policy; the background gate
+        # (engine.py:174 apply_background_checks) only applies to scans
+        background_mode = admission is None and pctx_factory is None
         wrapped = [Resource(r) for r in resources]
-        status, detail = self._device_statuses(resources)
-        match = self.match_matrix(resources, wrapped)
+        status, detail = self._device_statuses(resources, contexts)
+        match = self.match_matrix(resources, wrapped, admission)
         now = time.time()
 
         # which host policies could match each resource at all (group
-        # screen over their simple rules; non-simple rules force a run)
-        host_maybe = self._host_policy_maybe(resources, wrapped)
+        # screen over their simple rules; non-simple rules force a run);
+        # admission scans always run host policies (operation-sensitive)
+        host_maybe = self._host_policy_maybe(resources, wrapped) \
+            if background_mode else \
+            {p: None for p in self._host_policy_idx}
 
         out: List[List[EngineResponse]] = []
         for i, res_doc in enumerate(resources):
@@ -204,7 +249,7 @@ class BatchScanner:
                 if not match[i, j]:
                     continue
                 policy = self.policies[prog.policy_index]
-                if not policy.background:
+                if background_mode and not policy.background:
                     # background-disabled policies contribute an empty
                     # response (engine.py:174 apply_background_checks)
                     if prog.policy_index not in responses:
@@ -284,12 +329,20 @@ class BatchScanner:
             maybe[p_idx] = flags
         return maybe
 
+    def _pctx(self, policy: Policy, resource: dict) -> PolicyContext:
+        factory = getattr(self, '_pctx_factory', None)
+        if factory is not None:
+            pctx = factory(resource)
+            pctx = pctx.copy()
+            pctx.policy = policy
+            return pctx
+        return PolicyContext(policy, new_resource=resource)
+
     def _materialize(self, prog: RuleProgram,
                      resource: dict) -> Optional[RuleResponse]:
         """Produce the exact host-engine rule response for one rule."""
         from ..engine.engine import Validator
-        pctx = PolicyContext(self.policies[prog.policy_index],
-                             new_resource=resource)
+        pctx = self._pctx(self.policies[prog.policy_index], resource)
         rule = Rule(prog.rule_raw or {})
         return Validator(self.engine, pctx, rule).validate()
 
@@ -313,5 +366,9 @@ class BatchScanner:
 
     def _host_run(self, policy_index: int, resource: dict) -> EngineResponse:
         policy = self.policies[policy_index]
-        pctx = PolicyContext(policy, new_resource=resource)
-        return self.engine.apply_background_checks(pctx)
+        factory = getattr(self, '_pctx_factory', None)
+        if factory is not None:
+            pctx = self._pctx(policy, resource)
+            return self.engine.validate(pctx)
+        return self.engine.apply_background_checks(
+            PolicyContext(policy, new_resource=resource))

@@ -1521,15 +1521,19 @@ def unpack_batch(packed: Dict[str, Any],
 
 
 def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
-                axis: str = 'data') -> Dict[str, Any]:
+                axis: str = 'data', device=None) -> Dict[str, Any]:
     """Pack + place batch tensors, optionally sharded over a 1-D mesh
-    (the resource axis of packed stacks is axis 1).  int64 inputs are
+    (the resource axis of packed stacks is axis 1) or pinned to an
+    explicit single device (small-batch CPU path).  int64 inputs are
     transferred inside an x64 scope so they are not downcast.  Returns
     (packed_device_dict, layout)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     packed, layout = pack_batch(tensors)
     with enable_x64():
         if mesh is None:
+            if device is not None:
+                return ({k: jax.device_put(v, device)
+                         for k, v in packed.items()}, layout)
             return {k: jnp.asarray(v) for k, v in packed.items()}, layout
         out = {}
         for k, v in packed.items():

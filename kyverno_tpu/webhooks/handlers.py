@@ -187,7 +187,8 @@ class ResourceHandlers:
                  namespace_labels: Optional[Callable[[str], dict]] = None,
                  audit_sink: Optional[Callable] = None,
                  ur_sink: Optional[Callable] = None,
-                 registry_client=None):
+                 registry_client=None,
+                 device: bool = True):
         self.cache = cache
         self.engine = engine or Engine()
         self.pc_builder = pc_builder or admission.PolicyContextBuilder(
@@ -197,6 +198,21 @@ class ResourceHandlers:
         self.audit_sink = audit_sink
         self.ur_sink = ur_sink
         self.registry_client = registry_client
+        # the compiled device evaluator handles enforce validation for
+        # CREATE requests; rebuilt when the cached policy set changes
+        self.device = device
+        self._scanner = None
+        self._scanner_policies = None
+
+    def _device_scanner(self, policies):
+        if self._scanner_policies is not policies and \
+                (self._scanner_policies is None or
+                 [id(p) for p in self._scanner_policies] !=
+                 [id(p) for p in policies]):
+            from ..compiler.scan import BatchScanner
+            self._scanner = BatchScanner(policies, engine=self.engine)
+            self._scanner_policies = policies
+        return self._scanner
 
     # -- validate ---------------------------------------------------------
 
@@ -216,10 +232,26 @@ class ResourceHandlers:
         pctx.namespace_labels = self.namespace_labels(ns)
 
         responses: List[EngineResponse] = []
-        for policy in policies:
-            ctx = pctx.copy()
-            ctx.policy = policy
-            responses.append(self.engine.validate(ctx))
+        # device fast path: CREATE requests with no policy exceptions run
+        # through the compiled batch evaluator (exact via host fallback);
+        # UPDATE/DELETE keep the engine loop (old-resource match retry)
+        use_device = (self.device and policies and
+                      request.get('operation') == 'CREATE' and
+                      not pctx.exceptions)
+        if use_device:
+            scanner = self._device_scanner(policies)
+            resource = admission.request_resource(request)
+            [responses] = scanner.scan(
+                [resource],
+                contexts=[pctx.json_context._data],
+                admission=(pctx.admission_info, pctx.exclude_group_roles,
+                           pctx.namespace_labels, 'CREATE'),
+                pctx_factory=lambda doc: pctx)
+        else:
+            for policy in policies:
+                ctx = pctx.copy()
+                ctx.policy = policy
+                responses.append(self.engine.validate(ctx))
         if block_request(responses, failure_policy):
             return admission.response(uid, False,
                                       get_blocked_messages(responses))

@@ -279,3 +279,68 @@ spec:
         assert json.loads(body)['response']['allowed'] is True
         assert urs and urs[0]['type'] == 'generate'
         assert urs[0]['policy'] == 'add-networkpolicy'
+
+
+class TestDeviceAdmissionEquivalence:
+    """The device fast path must produce the same admission decision and
+    messages as the engine loop (operation context, userInfo vars)."""
+
+    PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: ops-policy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: only-create
+      match: {any: [{resources: {kinds: [Pod], operations: [CREATE]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.operation }}"
+            operator: Equals
+            value: CREATE
+      validate:
+        message: "pods need team"
+        pattern: {metadata: {labels: {team: "?*"}}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: user-policy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  background: false
+  rules:
+    - name: no-bob
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "bob may not create pods"
+        deny:
+          conditions:
+            all:
+              - key: "{{ request.userInfo.username }}"
+                operator: Equals
+                value: bob
+"""
+
+    def _responses(self, device, username, labels):
+        handlers = ResourceHandlers(make_cache(self.PACK), device=device)
+        server = WebhookServer(handlers)
+        r = review(pod(labels))
+        r['request']['userInfo']['username'] = username
+        body = server.handle('/validate/fail', json.dumps(r).encode())
+        return json.loads(body)['response']
+
+    def test_device_matches_engine_loop(self):
+        for username in ('alice', 'bob'):
+            for labels in ({}, {'team': 'x'}):
+                dev = self._responses(True, username, labels)
+                host = self._responses(False, username, labels)
+                assert dev['allowed'] == host['allowed'], (username, labels)
+                assert dev.get('status') == host.get('status'), \
+                    (username, labels)
+                assert dev.get('warnings') == host.get('warnings'), \
+                    (username, labels)
