@@ -413,9 +413,9 @@ def _cond_b_needs(check) -> LaneNeeds:
         ks = key if isinstance(key, str) else _sprint(key)
         n.length = True
         n.wild = True
-        # full head window: the scalar-value range/JSON suspicion scan
-        # needs to see every byte of the value string
-        n.head = STR_LEN
+        # the scalar-value suspicion scan marks values longer than the
+        # window as undecidable (host re-run), so a narrow head suffices
+        n.head = max(16, _blen(ks))
         n.add_pattern(ks)
     return n
 

@@ -172,30 +172,41 @@ class BatchScanner:
         if not self.cps.programs or not resources:
             z = np.zeros((len(resources), len(self.cps.programs)), np.int8)
             return z, z
+        from concurrent.futures import ThreadPoolExecutor
         from ..ops.eval import shard_batch
         n = len(resources)
         chunk = self.CHUNK
-        pending = []
-        for start in range(0, n, chunk):
-            part = resources[start:start + chunk]
-            part_ctx = contexts[start:start + chunk] \
-                if contexts is not None else None
-            # bucketed padding: power-of-two buckets below one chunk,
-            # exactly CHUNK otherwise → a handful of compiled shapes total
-            bucket = chunk if n > chunk else \
-                max(64, 1 << (len(part) - 1).bit_length())
-            batch = encode_batch(part, self.cps, padded_n=bucket,
-                                 contexts=part_ctx)
-            small = self.mesh is None and n <= self.SMALL_BATCH
-            device = self._small_device() if small else None
-            tensors, layout = shard_batch(batch.tensors(), self.mesh,
-                                          device=device)
-            # dispatch is async: the device evaluates this chunk while the
-            # host encodes the next one (the jax default double-buffering)
-            s, d = self._evaluator(tensors, layout)
-            pending.append((s, d, len(part)))
-        stats = [np.asarray(s)[:ln] for s, _, ln in pending]
-        dets = [np.asarray(d)[:ln] for _, d, ln in pending]
+        small = self.mesh is None and n <= self.SMALL_BATCH
+        device = self._small_device() if small else None
+
+        def dispatch(tensors, ln):
+            t, layout = shard_batch(tensors, self.mesh, device=device)
+            s, d = self._evaluator(t, layout)
+            return np.asarray(s)[:ln], np.asarray(d)[:ln]
+
+        # depth-2 pipeline: the host encodes chunk i+1 while a dispatch
+        # thread streams chunk i to the device and collects verdicts
+        results: List = []
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            futures = []
+            for start in range(0, n, chunk):
+                part = resources[start:start + chunk]
+                part_ctx = contexts[start:start + chunk] \
+                    if contexts is not None else None
+                # bucketed padding: power-of-two buckets below one chunk,
+                # exactly CHUNK otherwise → few compiled shapes total
+                bucket = chunk if n > chunk else \
+                    max(64, 1 << (len(part) - 1).bit_length())
+                batch = encode_batch(part, self.cps, padded_n=bucket,
+                                     contexts=part_ctx)
+                futures.append(pool.submit(dispatch, batch.tensors(),
+                                           len(part)))
+                while len(futures) > 2:
+                    results.append(futures.pop(0).result())
+            for f in futures:
+                results.append(f.result())
+        stats = [s for s, _ in results]
+        dets = [d for _, d in results]
         if len(stats) == 1:
             return stats[0], dets[0]
         return np.concatenate(stats), np.concatenate(dets)
