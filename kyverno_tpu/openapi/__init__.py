@@ -1,0 +1,3 @@
+"""OpenAPI schema validation (reference: pkg/openapi)."""
+
+from .manager import Manager, ValidationError  # noqa: F401

@@ -188,7 +188,12 @@ class ResourceHandlers:
                  audit_sink: Optional[Callable] = None,
                  ur_sink: Optional[Callable] = None,
                  registry_client=None,
-                 device: bool = True):
+                 device: bool = True,
+                 openapi_manager=None):
+        if openapi_manager is None:
+            from ..openapi.manager import Manager
+            openapi_manager = Manager()
+        self.openapi_manager = openapi_manager
         self.cache = cache
         self.engine = engine or Engine()
         self.pc_builder = pc_builder or admission.PolicyContextBuilder(
@@ -329,6 +334,19 @@ class ResourceHandlers:
                               for p in (rr.patches or [])]
             if policy_patches:
                 patches.extend(policy_patches)
+                # the mutated resource must stay schema-valid
+                # (reference: mutation.go → openapi.ValidateResource,
+                # pkg/openapi/manager.go:88)
+                if self.openapi_manager is not None and er.patched_resource:
+                    from ..openapi.manager import ValidationError
+                    try:
+                        self.openapi_manager.validate_resource(
+                            er.patched_resource)
+                    except ValidationError as e:
+                        return admission.response(
+                            uid, False,
+                            f'mutated resource failed schema validation: '
+                            f'{e}')
             # mutations apply cumulatively: the patched resource re-enters
             # the context for the next policy (mutation.go:123)
             pctx = pctx.copy()

@@ -1,0 +1,110 @@
+"""OpenAPI schema validation of mutated resources
+(reference: pkg/openapi/manager.go)."""
+
+import json
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.openapi.manager import Manager, ValidationError
+
+
+class TestValidateResource:
+    def test_accepts_valid_pod(self):
+        Manager().validate_resource({
+            'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'labels': {'a': 'b'}},
+            'spec': {'containers': [{'name': 'c'}]}})
+
+    def test_rejects_bad_types(self):
+        m = Manager()
+        with pytest.raises(ValidationError, match='labels'):
+            m.validate_resource({
+                'kind': 'Pod', 'metadata': {'labels': 'not-a-map'},
+                'spec': {}})
+        with pytest.raises(ValidationError, match='replicas'):
+            m.validate_resource({
+                'kind': 'Deployment', 'metadata': {'name': 'd'},
+                'spec': {'replicas': 'three'}})
+        with pytest.raises(ValidationError, match='containers'):
+            m.validate_resource({
+                'kind': 'Pod', 'metadata': {'name': 'p'},
+                'spec': {'containers': {'name': 'not-a-list'}}})
+
+    def test_unknown_kind_tolerated(self):
+        Manager().validate_resource({'kind': 'MyCRD',
+                                     'spec': 'anything-goes'})
+
+    def test_add_schema(self):
+        m = Manager()
+        m.add_schema('MyCRD', {'spec.size': 'integer'})
+        with pytest.raises(ValidationError):
+            m.validate_resource({'kind': 'MyCRD',
+                                 'spec': {'size': 'big'}})
+
+
+class TestPolicyMutationDryRun:
+    def test_valid_mutation_passes(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: ok, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: add-label
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              +(x): "y"
+"""))
+        Manager().validate_policy_mutation(policy)
+
+    def test_type_breaking_mutation_rejected(self):
+        policy = Policy(yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: bad, annotations: {pod-policies.kyverno.io/autogen-controllers: none}}
+spec:
+  rules:
+    - name: break-labels
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchesJson6902: |-
+          - op: add
+            path: /metadata/labels
+            value: "oops"
+"""))
+        with pytest.raises(ValidationError):
+            Manager().validate_policy_mutation(policy)
+
+
+class TestMutationWebhookIntegration:
+    def test_schema_breaking_patch_denied(self):
+        from tests.test_webhooks import make_cache, pod, review, serve
+        bad_mutate = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: break-replicas
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: bad
+      match: {any: [{resources: {kinds: [Deployment]}}]}
+      mutate:
+        patchesJson6902: |-
+          - op: add
+            path: /spec/replicas
+            value: "three"
+"""
+        server = serve(make_cache(bad_mutate))
+        deploy = {'apiVersion': 'apps/v1', 'kind': 'Deployment',
+                  'metadata': {'name': 'd', 'namespace': 'default'},
+                  'spec': {'replicas': 1}}
+        body = server.handle('/mutate', json.dumps(review(deploy)).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        assert 'schema validation' in resp['status']['message']
