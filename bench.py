@@ -284,7 +284,7 @@ def admission_latency(policies, resources, target_policies=1000,
 
 
 def main() -> int:
-    n = int(os.environ.get('BENCH_N', '20000'))
+    n = int(os.environ.get('BENCH_N', '50000'))
     platform = os.environ.get('BENCH_PLATFORM') or probe_platform()
     if platform == 'cpu':
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
