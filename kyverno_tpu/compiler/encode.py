@@ -375,15 +375,21 @@ def _cond_needs(check) -> LaneNeeds:
                     n.milli = True
                     n.nanos = True
                 else:
+                    # list keys run _both_dir_member over the parsed
+                    # JSON elements (or [v] itself): wildcard matching in
+                    # both directions needs has_wild plus the per-element
+                    # pattern windows (eval.py _in_family_tf)
+                    n.wild = True
                     import json as _json
                     try:
                         arr = _json.loads(v)
                     except ValueError:
                         arr = None
-                    if isinstance(arr, list):
-                        for x in arr:
-                            if isinstance(x, str):
-                                n.head = max(n.head, _blen(x))
+                    elems = [x for x in arr if isinstance(x, str)] \
+                        if isinstance(arr, list) else [v]
+                    for x in elems:
+                        n.head = max(n.head, _blen(x))
+                        n.add_pattern(x)
     else:  # numeric comparisons
         n.milli = True
         n.nanos = True

@@ -319,15 +319,19 @@ def _any_not_in(key: Any, value: Any) -> bool:
     return False
 
 
+def _k_in_wild(k: str, vals: List[str]) -> bool:
+    """Bidirectional wildcard membership (reference: anyin.go:190 isAnyIn
+    inner loop — wildcard.Match(key, val) || wildcard.Match(val, key))."""
+    return any(wildcard.match(k, v) or wildcard.match(v, k) for v in vals)
+
+
 def _any_set_in(keys: List[str], value: Any, negate: bool) -> bool:
-    # reference: operator/anyin.go:121 anySetExistsInArray
+    # reference: operator/anyin.go:124 anySetExistsInArray
     if isinstance(value, list):
         vals = [v if isinstance(v, str) else _sprint(v) for v in value]
         if negate:
-            return any(all(not (wildcard.match(k, v) or wildcard.match(v, k))
-                           for v in vals) for k in keys)
-        return any(any(wildcard.match(k, v) or wildcard.match(v, k)
-                       for v in vals) for k in keys)
+            return any(not _k_in_wild(k, vals) for k in keys)
+        return any(_k_in_wild(k, vals) for k in keys)
     if isinstance(value, str):
         if len(keys) == 1 and keys[0] == value:
             return not negate
@@ -339,10 +343,11 @@ def _any_set_in(keys: List[str], value: Any, negate: bool) -> bool:
         arr = _value_as_string_list(value)
         if arr is None:
             arr = [value]
-        arr_set = set(arr)
+        # reference parses the JSON/string form then runs the same
+        # isAnyIn/isAnyNotIn wildcard membership (anyin.go:168-183)
         if negate:
-            return any(k not in arr_set for k in keys)
-        return any(k in arr_set for k in keys)
+            return any(not _k_in_wild(k, arr) for k in keys)
+        return any(_k_in_wild(k, arr) for k in keys)
     return False
 
 
@@ -370,14 +375,14 @@ def _all_not_in(key: Any, value: Any) -> bool:
 
 
 def _all_set_in(keys: List[str], value: Any, negate: bool) -> bool:
-    # reference: operator/allin.go:112 allSetExistsInArray
+    # reference: operator/allin.go:112 allSetExistsInArray.  AllNotIn is
+    # universal (allin.go:192 isAllNotIn): false if ANY key element
+    # matches any value element.
     if isinstance(value, list):
         vals = [v if isinstance(v, str) else _sprint(v) for v in value]
-        def k_in(k):
-            return any(wildcard.match(k, v) or wildcard.match(v, k) for v in vals)
         if negate:
-            return any(not k_in(k) for k in keys)
-        return all(k_in(k) for k in keys)
+            return all(not _k_in_wild(k, vals) for k in keys)
+        return all(_k_in_wild(k, vals) for k in keys)
     if isinstance(value, str):
         if len(keys) == 1 and keys[0] == value:
             return not negate
@@ -388,10 +393,11 @@ def _all_set_in(keys: List[str], value: Any, negate: bool) -> bool:
         arr = _value_as_string_list(value)
         if arr is None:
             arr = [value]
-        arr_set = set(arr)
+        # same isAllIn/isAllNotIn wildcard membership as the list form
+        # (allin.go:137-139,168-170)
         if negate:
-            return any(k not in arr_set for k in keys)
-        return all(k in arr_set for k in keys)
+            return all(not _k_in_wild(k, arr) for k in keys)
+        return all(_k_in_wild(k, arr) for k in keys)
     return False
 
 

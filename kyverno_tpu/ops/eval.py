@@ -739,7 +739,7 @@ def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
     if check.list_value:
         em = _both_dir_member(ev, check.values)
         quant = {'anyin': 'any', 'allin': 'all',
-                 'anynotin': 'any_not', 'allnotin': 'any_not'}[op]
+                 'anynotin': 'any_not', 'allnotin': 'all_not'}[op]
     else:
         value = check.values[0]
         is_range = leaf_pattern.get_operator_from_string_pattern(value) == \
@@ -760,9 +760,14 @@ def _in_family_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
                 em = string_pattern_tf(ev, value)
                 quant = {'anyin': 'any', 'allin': 'all'}[op]
         else:
-            em = _arr_member(ev, value)
+            # JSON-list / plain string values run the same bidirectional
+            # wildcard membership as list values (anyin.go:168-183
+            # isAnyIn/isAnyNotIn over the parsed array)
+            arr = _try_json_str_list(value)
+            em = _both_dir_member(ev, tuple(arr if arr is not None
+                                            else [value]))
             quant = {'anyin': 'any', 'allin': 'all',
-                     'anynotin': 'any_not', 'allnotin': 'any_not'}[op]
+                     'anynotin': 'any_not', 'allnotin': 'all_not'}[op]
     lt, lf = _quantify(quant, em, elem_valid, overflow)
     if shortcut is not None:
         if negate:

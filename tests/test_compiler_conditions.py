@@ -332,6 +332,66 @@ class TestReviewRegressions:
         pod['spec']['containers'] = [{'name': 'c', 'image': 'a'}]
         self._check(p, pod)
 
+    def test_allnotin_universal(self):
+        # reference isAllNotIn (allin.go:192) is universal: false when ANY
+        # key element matches any value element
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AllNotIn', ['a', 'b'])
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'a'},
+                                     {'name': 'c1', 'image': 'z'}]
+        self._check(p, pod)  # 'a' matches → AllNotIn false → no deny
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'y'},
+                                     {'name': 'c1', 'image': 'z'}]
+        self._check(p, pod)  # nothing matches → AllNotIn true → deny
+
+    def test_allnotin_json_string_wildcards(self):
+        # JSON-string values run the same bidirectional wildcard
+        # membership as list values (allin.go:168-170)
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AllNotIn', '["nginx*"]')
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'nginx:1'},
+                                     {'name': 'c1', 'image': 'redis:7'}]
+        self._check(p, pod)
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'redis:7'}]
+        self._check(p, pod)
+
+    def test_anyin_json_string_wildcards(self):
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AnyIn', '["ghcr.io/*"]')
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'ghcr.io/a'}]
+        self._check(p, pod)
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'quay.io/a'}]
+        self._check(p, pod)
+
+    def test_in_family_wildcard_key_value_json_string(self):
+        # the KEY side may carry wildcard chars that match the value as a
+        # pattern (anyin.go:193 wildcard.Match(valKey, valValue))
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AnyIn', '["nginx:1"]')
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'nginx:*'}]
+        self._check(p, pod)
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'nginx:1'}]
+        self._check(p, pod)
+
+    def test_in_family_suffix_element_pattern(self):
+        # suffix-classified JSON elements must provision the tail lane
+        p = self._one_cond_policy(
+            '{{request.object.spec.containers[].image}}',
+            'AnyIn', '["*nginx"]')
+        pod = self._pod()
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'my-nginx'}]
+        self._check(p, pod)
+        pod['spec']['containers'] = [{'name': 'c0', 'image': 'redis'}]
+        self._check(p, pod)
+
     def test_empty_scan_statuses(self):
         scanner = BatchScanner(load_pack())
         status, detail, match = scanner.scan_statuses([])

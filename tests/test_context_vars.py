@@ -172,6 +172,14 @@ class TestOperators:
         assert self.ev(['c'], 'AnyNotIn', ['a', 'b'])
         assert not self.ev(['a'], 'AnyNotIn', ['a'])
         assert self.ev(['c', 'd'], 'AllNotIn', ['a', 'b'])
+        # AllNotIn is universal (reference allin.go:192 isAllNotIn):
+        # false when ANY key element matches
+        assert not self.ev(['a', 'b'], 'AllNotIn', ['a'])
+        assert not self.ev(['a', 'z'], 'AllNotIn', '["a","b"]')
+        # JSON-string values use bidirectional wildcard membership
+        assert not self.ev(['nginx:1'], 'AllNotIn', '["nginx*"]')
+        assert self.ev(['redis:7'], 'AllNotIn', '["nginx*"]')
+        assert self.ev(['nginx:1'], 'AnyIn', '["nginx*"]')
 
     def test_in_json_string_value(self):
         assert self.ev('a', 'In', '["a", "b"]')
