@@ -158,11 +158,39 @@ def load_policy_pack():
     return [Policy(d) for d in docs]
 
 
+def cache_probe(platform: str) -> float:
+    """Second-process warm-up with the persistent XLA compilation cache
+    populated: build the full-pack scanner and run one chunk-shaped scan.
+    Returns the compile+warm seconds the fresh process paid."""
+    code = (
+        'import sys, time, random; sys.path.insert(0, %r)\n'
+        'import bench\n'
+        'from kyverno_tpu.compiler.scan import BatchScanner\n'
+        't0 = time.time()\n'
+        'scanner = BatchScanner(bench.load_policy_pack())\n'
+        'rng = random.Random(0)\n'
+        'pods = [bench.make_pod(rng, i) for i in range(scanner.CHUNK)]\n'
+        'scanner.scan_statuses(pods)\n'
+        'print(f"CACHEPROBE {time.time() - t0:.2f}")\n'
+    ) % os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run([sys.executable, '-c', code],
+                             capture_output=True, text=True, timeout=600)
+        for line in out.stdout.splitlines():
+            if line.startswith('CACHEPROBE'):
+                return float(line.split()[1])
+    except Exception:  # noqa: BLE001 - probe is informational
+        pass
+    return -1.0
+
+
 def run_bench(n: int, platform: str) -> dict:
     import random
     from kyverno_tpu.compiler.scan import BatchScanner
     from kyverno_tpu.compiler.ir import (STATUS_HOST, STATUS_PASS,
                                          STATUS_SKIP_PRECOND, STATUS_VAR_ERR)
+    from kyverno_tpu.reports.types import new_background_scan_report
+    from kyverno_tpu.reports.results import set_responses
 
     policies = load_policy_pack()
     rng = random.Random(42)
@@ -173,23 +201,72 @@ def run_bench(n: int, platform: str) -> dict:
     compile_s = time.time() - t0
     n_rules = len(scanner.cps.programs) + len(scanner.cps.host_rules)
 
-    # warm the jit cache at the real chunk shape so the one-time XLA
-    # compile is excluded from the steady-state throughput
+    # warm the jit cache at the real chunk shape (and the small-bucket
+    # shape) so the one-time XLA compile is excluded from steady state;
+    # reported separately — a policy-set change pays this again unless
+    # the persistent compilation cache hits
     warm_n = min(n, scanner.CHUNK + 1)
     t_warm = time.time()
-    scanner.scan_statuses(resources[:warm_n])
+    scanner.scan(resources[:warm_n])
     warm_s = time.time() - t_warm
 
-    t1 = time.time()
-    status, detail, match = scanner.scan_statuses(resources)
-    scan_s = time.time() - t1
+    # count host materializations to keep the device-decided fraction
+    # honest: every cell NOT synthesized from device outputs re-runs the
+    # host engine and caps throughput
+    materialized = [0]
+    inner_materialize = scanner._materialize
 
-    decisions = int(match.sum())
+    def counting_materialize(prog, doc):
+        materialized[0] += 1
+        return inner_materialize(prog, doc)
+    scanner._materialize = counting_materialize
+
+    # HEADLINE: the report-producing path — full EngineResponses with
+    # host-identical messages, then BackgroundScanReport construction
+    # (what reports/controllers.py BackgroundScanController.reconcile runs)
+    t1 = time.time()
+    out = scanner.scan(resources)
+    scan_s = time.time() - t1
+    decisions = sum(len(r.policy_response.rules)
+                    for responses in out for r in responses)
+    # rule responses produced by compiled programs (host-policy rules run
+    # the host engine by design and must not dilute device_decided_frac)
+    host_policy_names = {scanner.policies[i].name
+                         for i in scanner._host_policy_idx}
+    compiled_decisions = sum(
+        len(r.policy_response.rules) for responses in out
+        for r in responses
+        if r.policy_response.policy_name not in host_policy_names)
+
+    t2 = time.time()
+    reports = []
+    for resource, responses in zip(resources, out):
+        report = new_background_scan_report(resource)
+        relevant = [r for r in responses if r.policy_response.rules]
+        set_responses(report, *relevant)
+        reports.append(report)
+    report_s = time.time() - t2
+    e2e_s = scan_s + report_s
+    rate = decisions / e2e_s if e2e_s > 0 else 0.0
+
+    # the raw status sieve (no response objects), reported separately
+    t3 = time.time()
+    status, detail, match = scanner.scan_statuses(resources)
+    sieve_s = time.time() - t3
+    sieve_rate = int(match.sum()) / sieve_s if sieve_s > 0 else 0.0
     synth = (status == STATUS_PASS) | (status == STATUS_SKIP_PRECOND) | \
         (status == STATUS_VAR_ERR)
-    device_decided = int((match & synth).sum())
-    host_needed = int((match & (status == STATUS_HOST)).sum())
-    nonpass = decisions - int((match & (status == STATUS_PASS)).sum())
+    host_status_frac = int((match & (status == STATUS_HOST)).sum()) / \
+        max(int(match.sum()), 1)
+    nonpass = int(match.sum()) - int((match & (status == STATUS_PASS)).sum())
+
+    device_decided_frac = 1.0 - materialized[0] / max(compiled_decisions, 1)
+    warning = None
+    if device_decided_frac < 0.95:
+        warning = (f'device_decided_frac dropped to '
+                   f'{device_decided_frac:.3f} — host materialization is '
+                   f'capping throughput')
+        print(f'WARNING: {warning}', file=sys.stderr)
 
     # host-engine baseline on a sample (the pure-Python interpreter this
     # repo would use without the device path; the reference Go engine is
@@ -198,23 +275,27 @@ def run_bench(n: int, platform: str) -> dict:
     from kyverno_tpu.engine.engine import Engine
     from kyverno_tpu.engine.api import PolicyContext
     engine = Engine()
-    t2 = time.time()
+    t4 = time.time()
     host_dec = 0
     for doc in resources[:sample]:
         for policy in policies:
             resp = engine.apply_background_checks(
                 PolicyContext(policy, new_resource=doc))
             host_dec += len(resp.policy_response.rules)
-    host_s = time.time() - t2
+    host_s = time.time() - t4
     host_rate = host_dec / host_s if host_s > 0 else 0.0
 
-    # admission p50 latency through the full serving chain at ~1k policies
+    # admission latency through the full serving chain at ~1k policies
     # (BASELINE metric: 'p50 webhook latency @1k policies')
-    lat_p50_ms, lat_n_policies = admission_latency(policies, resources)
+    lat_p50_ms, lat_p99_ms, lat_n_policies = admission_latency(
+        policies, resources)
 
-    rate = decisions / scan_s if scan_s > 0 else 0.0
-    return {
-        'metric': 'bg_scan_decisions_per_sec_per_chip',
+    # fresh-process warm time with the persistent compilation cache
+    cache_warm_s = cache_probe(platform) \
+        if os.environ.get('BENCH_CACHE_PROBE', '1') == '1' else -1.0
+
+    result = {
+        'metric': 'bg_scan_e2e_decisions_per_sec_per_chip',
         'value': round(rate, 1),
         'unit': 'decisions/s',
         'vs_baseline': round(rate / PER_CHIP_TARGET, 3),
@@ -224,24 +305,33 @@ def run_bench(n: int, platform: str) -> dict:
         'n_rules': n_rules,
         'n_compiled_rules': len(scanner.cps.programs),
         'decisions': decisions,
-        'device_decided_frac': round(device_decided / max(decisions, 1), 4),
-        'host_fallback_frac': round(host_needed / max(decisions, 1), 4),
-        'nonpass_frac': round(nonpass / max(decisions, 1), 4),
+        'n_reports': len(reports),
+        'device_decided_frac': round(device_decided_frac, 4),
+        'materialized': materialized[0],
+        'host_status_frac': round(host_status_frac, 4),
+        'nonpass_frac': round(nonpass / max(int(match.sum()), 1), 4),
         'compile_s': round(compile_s, 2),
         'warm_s': round(warm_s, 2),
         'scan_s': round(scan_s, 2),
+        'report_s': round(report_s, 2),
+        'cache_warm_s': round(cache_warm_s, 2),
+        'sieve_decisions_per_sec': round(sieve_rate, 1),
         'host_engine_decisions_per_sec': round(host_rate, 1),
         'speedup_vs_host_engine': round(rate / host_rate, 2)
         if host_rate else None,
         'admission_p50_ms': lat_p50_ms,
+        'admission_p99_ms': lat_p99_ms,
         'admission_n_policies': lat_n_policies,
     }
+    if warning:
+        result['warning'] = warning
+    return result
 
 
 def admission_latency(policies, resources, target_policies=1000,
-                      samples=60):
-    """p50 latency of /validate through the full handler chain with the
-    pack replicated to ~1k policies (enforce mode)."""
+                      samples=120):
+    """p50/p99 latency of /validate through the full handler chain with
+    the pack replicated to ~1k policies (enforce mode)."""
     import copy
     import json as _json
     import statistics
@@ -280,7 +370,10 @@ def admission_latency(policies, resources, target_policies=1000,
         t0 = time.time()
         server.handle('/validate/fail', review)
         lat.append((time.time() - t0) * 1000)
-    return round(statistics.median(lat), 2), len(replicated)
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    return (round(statistics.median(lat), 2), round(p99, 2),
+            len(replicated))
 
 
 def main() -> int:

@@ -85,6 +85,13 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     error_messages: List[str] = []
     pss = None
     skip_message = None
+    fail_sites: Optional[List[str]] = None
+    fail_prefix = None
+    deny_fail_message = None
+    any_fail_sites = None
+    any_fail_prefix = None
+    msg = (validate.get('message') or '') if isinstance(validate, dict) else ''
+    static_msg = isinstance(msg, str) and '{{' not in msg and '$(' not in msg
 
     # preconditions gate everything (engine.py Validator.validate order)
     if rule.get('preconditions') is not None:
@@ -101,20 +108,52 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
             'failed to substitute variables in deny conditions',
             error_messages)
         units.append(StatusExpr('deny', expr=deny, operand=plan))
+        if static_msg:
+            # deny FAIL message is the (static) message verbatim, or the
+            # no-message fallback (engine.py:446 _deny_message)
+            deny_fail_message = msg or \
+                f'validation error: rule {name} failed'
     elif validate.get('pattern') is not None:
-        units.append(_compile_pattern_status(cps, validate['pattern']))
+        if static_msg:
+            # FAIL messages with a non-empty path are fully determined by
+            # (static message, rule name, failing path) — engine.py:543
+            # _error_message / reference validation.go:722
+            fail_sites = []
+            if msg:
+                dot = msg if msg.endswith('.') else msg + '.'
+                fail_prefix = (f'validation error: {dot} rule {name} '
+                               f'failed at path ')
+            else:
+                fail_prefix = (f'validation error: rule {name} '
+                               f'failed at path ')
+        units.append(_compile_pattern_status(cps, validate['pattern'],
+                                             sites=fail_sites))
     elif validate.get('anyPattern') is not None:
         pats = validate['anyPattern']
         if not isinstance(pats, list):
             raise CompileError('anyPattern must be a list')
-        children = [_compile_pattern_status(cps, p, in_any_pattern=True)
-                    for p in pats]
+        any_sites: Optional[List[List[str]]] = \
+            [[] for _ in pats] if static_msg else None
+        children = [
+            _compile_pattern_status(
+                cps, p, in_any_pattern=True,
+                sites=any_sites[i] if any_sites is not None else None)
+            for i, p in enumerate(pats)]
         units.append(StatusExpr('any', children=tuple(children)))
         # pass message carries the index of the sub-pattern that matched
         # (engine.py:514, reference: pkg/engine/validation.go:640)
         pass_messages = tuple(
             f"validation rule '{name}' anyPattern[{i}] passed."
             for i in range(len(pats)))
+        if any_sites is not None:
+            any_fail_sites = tuple(tuple(s) for s in any_sites)
+            # buildAnyPatternErrorMessage prefix (engine.py:565)
+            if not msg:
+                any_fail_prefix = 'validation error: '
+            elif msg.endswith('.'):
+                any_fail_prefix = f'validation error: {msg} '
+            else:
+                any_fail_prefix = f'validation error: {msg}. '
     elif validate.get('podSecurity') is not None:
         # host dispatch order: podSecurity before foreach (engine.py:403)
         from .pss_compile import compile_pod_security
@@ -129,6 +168,12 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         # foreach pass/skip messages are static (engine.py:625-630)
         pass_messages = ('rule passed',)
         skip_message = 'rule skipped'
+        if static_msg:
+            # a deny-decided element failure wraps the (static) deny
+            # message (engine.py:665 'validation failure: …'); the
+            # evaluator emits fdet>=0 only for unambiguous deny fails
+            inner = msg or f'validation error: rule {name} failed'
+            deny_fail_message = f'validation failure: {inner}'
     else:
         raise CompileError('no compilable validate sub-key')
 
@@ -139,7 +184,10 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         pass_messages=pass_messages,
         error_messages=tuple(error_messages), pss=pss,
         skip_message=skip_message,
-        background=policy.background, rule_raw=rule)
+        background=policy.background, rule_raw=rule,
+        fail_sites=tuple(fail_sites) if fail_sites is not None else None,
+        fail_prefix=fail_prefix, deny_fail_message=deny_fail_message,
+        any_fail_sites=any_fail_sites, any_fail_prefix=any_fail_prefix)
 
 
 def _error_plan(cps: CompiledPolicySet, conditions: Any, prefix: str,
@@ -191,17 +239,51 @@ def _check_no_vars(value: Any) -> None:
             _check_no_vars(v)
 
 
+def _path_template(path: Tuple[str, ...], parent: bool = False) -> str:
+    """Host walk path for a slot path: '/spec/containers/{e0}/image/'.
+    ``parent`` drops the last component (the map-level '*' shortcut
+    reports the parent map's path — anchor.py:214)."""
+    parts = path[:-1] if parent else path
+    out = '/'
+    e = 0
+    for p in parts:
+        if p == '*':
+            out += '{e%d}/' % e
+            e += 1
+        else:
+            out += f'{p}/'
+    return out
+
+
+def _new_site(sites: Optional[List[str]], path: Tuple[str, ...],
+              parent: bool = False) -> Optional[int]:
+    if sites is None:
+        return None
+    sites.append(_path_template(path, parent))
+    return len(sites) - 1
+
+
 def _compile_pattern_status(cps: CompiledPolicySet, pattern: Any,
-                            in_any_pattern: bool = False) -> StatusExpr:
+                            in_any_pattern: bool = False,
+                            sites: Optional[List[str]] = None) -> StatusExpr:
     """Compile one pattern tree rooted at the resource document."""
     _check_no_vars(pattern)
     if not isinstance(pattern, dict):
         raise CompileError('top-level pattern must be a map')
     tracked: List[Slot] = []
-    status = _compile_map(cps, pattern, (), tracked)
-    if in_any_pattern or not tracked:
+    status = _compile_map(cps, pattern, (), tracked, sites)
+    if in_any_pattern:
         # anyPattern sub-failures stay failures regardless of missing anchor
-        # keys (engine.py:524 treats empty-path errors as plain failures)
+        # keys (engine.py:524 treats empty-path errors as plain failures) —
+        # but an empty-path failure has a different message ('failed: {pe}'
+        # vs 'failed at path {p}'), so the fail-detail is guarded on all
+        # tracked anchor keys being present
+        if sites is not None and tracked:
+            guards = [BoolExpr.of(Leaf(s, 'star')) for s in tracked]
+            return StatusExpr('failguard', expr=BoolExpr.all(guards),
+                              sub=status)
+        return status
+    if not tracked:
         return status
     # single-pattern quirk (validate_pattern.match_pattern:38 +
     # engine.py:493): a plain FAIL while any tracked condition/existence/
@@ -216,7 +298,8 @@ def _phase1_sort_key(key: str) -> str:
 
 
 def _compile_map(cps: CompiledPolicySet, pattern: dict,
-                 path: Tuple[str, ...], tracked: List[Slot]) -> StatusExpr:
+                 path: Tuple[str, ...], tracked: List[Slot],
+                 sites: Optional[List[str]] = None) -> StatusExpr:
     """Compile a pattern map at ``path`` (``'*'`` marks element scope).
 
     Mirrors _validate_map: phase 1 anchors in sorted key order, then plain
@@ -244,14 +327,16 @@ def _compile_map(cps: CompiledPolicySet, pattern: dict,
         cps.slot_id(slot)
         if anchor_mod.is_condition(a):
             tracked.append(slot)
-            sub = _compile_element(cps, value, child_path, tracked)
+            sub = _compile_element(cps, value, child_path, tracked, sites)
             children.append(StatusExpr('cond', slot=slot, sub=sub))
         elif anchor_mod.is_equality(a):
-            sub = _compile_element(cps, value, child_path, tracked)
+            sub = _compile_element(cps, value, child_path, tracked, sites)
             children.append(StatusExpr('equality', slot=slot, sub=sub))
         elif anchor_mod.is_negation(a):
             tracked.append(slot)
-            children.append(StatusExpr('negation', slot=slot))
+            children.append(StatusExpr(
+                'negation', slot=slot,
+                fail_site=_new_site(sites, child_path)))
         elif anchor_mod.is_existence(a):
             tracked.append(slot)
             if not isinstance(value, list) or not value or \
@@ -259,9 +344,14 @@ def _compile_map(cps: CompiledPolicySet, pattern: dict,
                 raise CompileError('existence anchor pattern must be a '
                                    'list of maps')
             for elem_pattern in value:
+                # existence failures always report the anchored key's
+                # path (anchor.py:250), so element subtrees need no sites
                 elem_sub = _compile_elem_map(cps, elem_pattern,
-                                             child_path + ('*',), tracked)
-                children.append(StatusExpr('exists', slot=slot, sub=elem_sub))
+                                             child_path + ('*',), tracked,
+                                             None)
+                children.append(StatusExpr(
+                    'exists', slot=slot, sub=elem_sub,
+                    fail_site=_new_site(sites, child_path)))
 
     for key in _plain_order(plains):
         a, value = plains[key]
@@ -273,21 +363,24 @@ def _compile_map(cps: CompiledPolicySet, pattern: dict,
             slot = Slot(child_path)
             _require_depth(slot)
             cps.slot_id(slot)
-            sub = _compile_element(cps, value, child_path, tracked)
+            sub = _compile_element(cps, value, child_path, tracked, sites)
             children.append(StatusExpr('global', slot=slot, sub=sub))
             continue
         if a is not None and anchor_mod.is_add_if_not_present(a):
             continue  # mutation-only anchor: no-op during validation
         # default key (anchor.py handle_element default branch): the
-        # "*" pattern passes on any non-null value, fails when missing
+        # "*" pattern passes on any non-null value, fails when missing —
+        # reported at the parent map's path (anchor.py:214)
         if value == '*':
             slot = Slot(child_path)
             _require_depth(slot)
             cps.slot_id(slot)
             children.append(StatusExpr(
-                'leaf', expr=BoolExpr.of(Leaf(slot, 'star'))))
+                'leaf', expr=BoolExpr.of(Leaf(slot, 'star')),
+                fail_site=_new_site(sites, child_path, parent=True)))
             continue
-        children.append(_compile_element(cps, value, child_path, tracked))
+        children.append(_compile_element(cps, value, child_path, tracked,
+                                         sites))
 
     return StatusExpr.seq(children)
 
@@ -310,8 +403,8 @@ def _require_depth(slot: Slot) -> None:
 
 
 def _compile_element(cps: CompiledPolicySet, pattern: Any,
-                     path: Tuple[str, ...],
-                     tracked: List[Slot]) -> StatusExpr:
+                     path: Tuple[str, ...], tracked: List[Slot],
+                     sites: Optional[List[str]] = None) -> StatusExpr:
     """Compile _validate_element dispatch for the value at ``path``.
 
     Mirrors validate_pattern._validate_element: maps need a map resource,
@@ -322,36 +415,43 @@ def _compile_element(cps: CompiledPolicySet, pattern: Any,
     _require_depth(slot)
     cps.slot_id(slot)
     if isinstance(pattern, dict):
-        is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')))
-        sub = _compile_map(cps, pattern, path, tracked)
+        is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')),
+                            fail_site=_new_site(sites, path))
+        sub = _compile_map(cps, pattern, path, tracked, sites)
         return StatusExpr.seq([is_map, sub])
     if isinstance(pattern, list):
         if not pattern:
             raise CompileError('empty pattern array')
         first = pattern[0]
-        is_arr = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_array')))
+        is_arr = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_array')),
+                            fail_site=_new_site(sites, path))
         if isinstance(first, dict):
             # validateArrayOfMaps uses only the first pattern element
             # (reference: pkg/engine/validate/validate.go:168-173)
-            elem_sub = _compile_elem_map(cps, first, path + ('*',), tracked)
-            forall = StatusExpr('forall', slot=slot, sub=elem_sub)
+            elem_sub = _compile_elem_map(cps, first, path + ('*',), tracked,
+                                         sites)
+            forall = StatusExpr('forall', slot=slot, sub=elem_sub,
+                                fail_site=_new_site(sites, path))
             return StatusExpr.seq([is_arr, forall])
         if isinstance(first, (str, int, float, bool)) or first is None:
             # scalar array pattern: every element must match the scalar
             # (validate.go:104 routes the array through the scalar leaf,
-            # validate_pattern.py:61-66 checks each element)
+            # validate_pattern.py:61-66 checks each element); failures
+            # report the ARRAY's path, no element index
             check = _compile_leaf(cps, path + ('*',), first)
             return StatusExpr.seq(
-                [is_arr, StatusExpr('scalars', slot=slot, expr=check)])
+                [is_arr, StatusExpr('scalars', slot=slot, expr=check,
+                                    fail_site=_new_site(sites, path))])
         raise CompileError('typed array patterns not vectorized')
     if isinstance(pattern, (str, int, float, bool)) or pattern is None:
-        return StatusExpr('leaf', expr=_compile_leaf(cps, path, pattern))
+        return StatusExpr('leaf', expr=_compile_leaf(cps, path, pattern),
+                          fail_site=_new_site(sites, path))
     raise CompileError(f'unsupported pattern type {type(pattern).__name__}')
 
 
 def _compile_elem_map(cps: CompiledPolicySet, elem_pattern: dict,
-                      elem_path: Tuple[str, ...],
-                      tracked: List[Slot]) -> StatusExpr:
+                      elem_path: Tuple[str, ...], tracked: List[Slot],
+                      sites: Optional[List[str]] = None) -> StatusExpr:
     """Compile the per-element pattern of an array-of-maps walk.
 
     validateArrayOfMaps calls validateResourceElement per element, so a
@@ -362,8 +462,9 @@ def _compile_elem_map(cps: CompiledPolicySet, elem_pattern: dict,
     slot = Slot(elem_path)
     _require_depth(slot)
     cps.slot_id(slot)
-    is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')))
-    sub = _compile_map(cps, elem_pattern, elem_path, tracked)
+    is_map = StatusExpr('leaf', expr=BoolExpr.of(Leaf(slot, 'is_map')),
+                        fail_site=_new_site(sites, elem_path))
+    sub = _compile_map(cps, elem_pattern, elem_path, tracked, sites)
     return StatusExpr.seq([is_map, sub])
 
 

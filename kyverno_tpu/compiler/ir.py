@@ -293,6 +293,10 @@ class StatusExpr:
     sub: Optional['StatusExpr'] = None
     children: Tuple['StatusExpr', ...] = ()
     operand: Any = None
+    # fail-site id: index into RuleProgram.fail_sites identifying the walk
+    # position (path template) the host would report for a FAIL decided at
+    # this node; None → a FAIL here is not message-synthesizable on device
+    fail_site: Optional[int] = None
 
     @staticmethod
     def const(status: int) -> 'StatusExpr':
@@ -339,6 +343,23 @@ class RuleProgram:
     background: bool = True
     # the original rule dict (for host-side match evaluation + fallback)
     rule_raw: Optional[dict] = None
+    # --- device FAIL-message synthesis (single-pattern + deny rules) ----
+    # fail-site path templates indexed by the evaluator's ``fdet`` output
+    # (site = fdet >> 16, element indices in the low bytes); '{e0}'/'{e1}'
+    # mark array positions.  None → FAIL cells re-run on the host.
+    fail_sites: Optional[Tuple[str, ...]] = None
+    # static message prefix: full FAIL message = fail_prefix + path
+    # (reference format: pkg/engine/validation.go:722 buildErrorMessage)
+    fail_prefix: Optional[str] = None
+    # static deny FAIL message (reference: validation.go:460 getDenyMessage);
+    # for foreach rules this is the wrapped 'validation failure: …' form
+    # (engine.py:665) and is gated on the evaluator's fdet >= 0
+    deny_fail_message: Optional[str] = None
+    # anyPattern synthesis: per-sub-pattern fail-site tables + the message
+    # prefix of buildAnyPatternErrorMessage (validation.go:746); failing
+    # children contribute 'rule NAME[i] failed at path P' parts in order
+    any_fail_sites: Optional[Tuple[Tuple[str, ...], ...]] = None
+    any_fail_prefix: Optional[str] = None
 
 
 @dataclass(frozen=True)

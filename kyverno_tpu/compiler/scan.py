@@ -41,6 +41,69 @@ _SIMPLE_MATCH_KEYS = {'kinds', 'namespaces', 'operations'}
 
 PRECONDITIONS_SKIP_MESSAGE = 'preconditions not met'
 
+# ---------------------------------------------------------------------------
+# Encoder process pool: encode_batch is pure numpy/Python (no jax), so
+# chunks encode in forked workers off the main interpreter's GIL — the
+# assembly loop and the encoder no longer serialize against each other.
+
+_ENCODER_CPS: Optional['CompiledPolicySet'] = None
+_ENCODER_FORK_LOCK = __import__('threading').Lock()
+
+
+def _encode_worker(args):
+    docs, contexts, padded_n = args
+    batch = encode_batch(docs, _ENCODER_CPS, padded_n=padded_n,
+                         contexts=contexts)
+    return batch.tensors()
+
+
+class _EncoderPool:
+    """Lazy forked pool; falls back to in-process encoding on failure."""
+
+    def __init__(self, cps, procs: int):
+        self.cps = cps
+        self.procs = procs
+        self._pool = None
+        self._broken = False
+
+    def start(self) -> bool:
+        if self._broken or self.procs <= 0:
+            return False
+        if self._pool is None:
+            global _ENCODER_CPS
+            try:
+                import multiprocessing as mp
+                import weakref
+                with _ENCODER_FORK_LOCK:
+                    # the global must stay pinned to this cps until the
+                    # fork snapshots it — concurrent pool starts from
+                    # other scanners would capture the wrong policy set
+                    _ENCODER_CPS = self.cps
+                    pool = mp.get_context('fork').Pool(self.procs)
+                self._pool = pool
+                # weakref.finalize runs at collection OR interpreter exit
+                # (atexit=True default), so workers are reaped when the
+                # scanner is dropped and mp.Pool.__del__ never races the
+                # shutdown pickler
+                self._finalizer = weakref.finalize(self, pool.terminate)
+            except Exception:  # noqa: BLE001 - pool is an optimization
+                self._broken = True
+                return False
+        return True
+
+    def submit(self, docs, contexts, padded_n):
+        return self._pool.apply_async(_encode_worker,
+                                      ((docs, contexts, padded_n),))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            fin = getattr(self, '_finalizer', None)
+            if fin is not None:
+                fin()  # idempotent: terminates the pool once
+            else:
+                self._pool.terminate()
+            self._pool = None
+
 
 def _rule_match_is_simple(rule: dict) -> bool:
     """True when match/exclude depend only on kind/apiVersion/namespace."""
@@ -93,6 +156,15 @@ class BatchScanner:
             _rule_match_is_simple(p.rule_raw or {}) for p in self.cps.programs]
         self._match_cache: Dict[Tuple, np.ndarray] = {}
         self._rules = [Rule(p.rule_raw or {}) for p in self.cps.programs]
+        self._fail_msg_cache: Dict[Tuple, Optional[str]] = {}
+        self._encoder_pool = _EncoderPool(
+            self.cps,
+            int(__import__('os').environ.get('KTPU_ENCODE_PROCS', '2')))
+        # static per-policy response header fields (avoids re-deriving
+        # them from the raw policy dict per (resource, policy) pair)
+        self._policy_header = [
+            (p, p.name, p.namespace, p.validation_failure_action,
+             p.validation_failure_action_overrides) for p in policies]
 
     # -- match --------------------------------------------------------------
 
@@ -157,6 +229,10 @@ class BatchScanner:
     #: round trip (latency floor), while bulk scans amortize it
     SMALL_BATCH = int(__import__('os').environ.get(
         'KTPU_SMALL_BATCH', '64'))
+    #: upper bound on one forked-encoder chunk (normal: ~2s); beyond this
+    #: the worker is presumed dead and the chunk re-encodes in-process
+    ENCODE_TIMEOUT_S = float(__import__('os').environ.get(
+        'KTPU_ENCODE_TIMEOUT', '120'))
 
     def _small_device(self):
         import jax
@@ -167,56 +243,117 @@ class BatchScanner:
             return None
         return None
 
-    def _device_statuses(self, resources: List[dict],
-                         contexts: Optional[List[dict]] = None):
+    def _device_status_chunks(self, resources: List[dict],
+                              contexts: Optional[List[dict]] = None):
+        """Yield ``(start, status, detail, fdet)`` per fixed-size chunk.
+
+        Three-stage pipeline: an encode thread projects chunk i+2 onto the
+        slot table while a dispatch thread streams chunk i+1 to the device
+        and the caller (response assembly / aggregation) consumes chunk i
+        — end-to-end rate ≈ max(stage) instead of sum(stage)."""
+        n = len(resources)
         if not self.cps.programs or not resources:
-            z = np.zeros((len(resources), len(self.cps.programs)), np.int8)
-            return z, z
+            z = np.zeros((n, len(self.cps.programs)), np.int8)
+            yield 0, z, z, z.astype(np.int32)
+            return
         from concurrent.futures import ThreadPoolExecutor
         from ..ops.eval import shard_batch
-        n = len(resources)
         chunk = self.CHUNK
         small = self.mesh is None and n <= self.SMALL_BATCH
         device = self._small_device() if small else None
 
-        def dispatch(tensors, ln):
-            t, layout = shard_batch(tensors, self.mesh, device=device)
-            s, d = self._evaluator(t, layout)
-            return np.asarray(s)[:ln], np.asarray(d)[:ln]
+        # multi-chunk scans encode in forked worker processes (off-GIL);
+        # small scans stay in-process
+        use_procs = n > chunk and self._encoder_pool.start()
 
-        # depth-2 pipeline: the host encodes chunk i+1 while a dispatch
-        # thread streams chunk i to the device and collects verdicts
-        results: List = []
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            futures = []
+        def inline_encode(part, part_ctx, bucket):
+            batch = encode_batch(part, self.cps, padded_n=bucket,
+                                 contexts=part_ctx)
+            return batch.tensors()
+
+        def encode(start):
+            part = resources[start:start + chunk]
+            part_ctx = contexts[start:start + chunk] \
+                if contexts is not None else None
+            # bucketed padding: power-of-two buckets below one chunk,
+            # exactly CHUNK otherwise → few compiled shapes total
+            bucket = chunk if n > chunk else \
+                max(64, 1 << (len(part) - 1).bit_length())
+            if use_procs:
+                try:
+                    async_res = self._encoder_pool.submit(part, part_ctx,
+                                                          bucket)
+                    return (async_res, part, part_ctx, bucket), len(part)
+                except Exception:  # noqa: BLE001 - fall back in-process
+                    pass
+            return inline_encode(part, part_ctx, bucket), len(part)
+
+        def dispatch(enc_future):
+            tensors, ln = enc_future.result()
+            if not isinstance(tensors, dict):
+                # AsyncResult from the fork pool: a dead/OOM-killed worker
+                # never resolves its task, so bound the wait and redo the
+                # chunk in-process rather than wedging the whole scan
+                async_res, part, part_ctx, bucket = tensors
+                if self._encoder_pool._broken:
+                    # pool already declared dead: don't wait another
+                    # timeout per in-flight chunk
+                    tensors = inline_encode(part, part_ctx, bucket)
+                else:
+                    try:
+                        tensors = async_res.get(
+                            timeout=self.ENCODE_TIMEOUT_S)
+                    except Exception:  # noqa: BLE001 - worker death
+                        self._encoder_pool.close()
+                        self._encoder_pool._broken = True
+                        tensors = inline_encode(part, part_ctx, bucket)
+            t, layout = shard_batch(tensors, self.mesh, device=device)
+            s, d, fd = self._evaluator(t, layout)
+            return (np.asarray(s)[:ln], np.asarray(d)[:ln],
+                    np.asarray(fd)[:ln])
+
+        if n <= chunk:
+            # single-chunk fast path: thread-pool spawn/join costs more
+            # than it hides for one chunk (admission latency floor)
+            class _Now:
+                def __init__(self, v):
+                    self._v = v
+
+                def result(self):
+                    return self._v
+            yield (0, *dispatch(_Now(encode(0))))
+            return
+
+        from collections import deque
+        with ThreadPoolExecutor(max_workers=1) as enc_pool, \
+                ThreadPoolExecutor(max_workers=1) as disp_pool:
+            inflight: deque = deque()
             for start in range(0, n, chunk):
-                part = resources[start:start + chunk]
-                part_ctx = contexts[start:start + chunk] \
-                    if contexts is not None else None
-                # bucketed padding: power-of-two buckets below one chunk,
-                # exactly CHUNK otherwise → few compiled shapes total
-                bucket = chunk if n > chunk else \
-                    max(64, 1 << (len(part) - 1).bit_length())
-                batch = encode_batch(part, self.cps, padded_n=bucket,
-                                     contexts=part_ctx)
-                futures.append(pool.submit(dispatch, batch.tensors(),
-                                           len(part)))
-                while len(futures) > 2:
-                    results.append(futures.pop(0).result())
-            for f in futures:
-                results.append(f.result())
-        stats = [s for s, _ in results]
-        dets = [d for _, d in results]
-        if len(stats) == 1:
-            return stats[0], dets[0]
-        return np.concatenate(stats), np.concatenate(dets)
+                inflight.append(
+                    (start,
+                     disp_pool.submit(dispatch,
+                                      enc_pool.submit(encode, start))))
+                while len(inflight) > 2:
+                    s0, f = inflight.popleft()
+                    yield (s0, *f.result())
+            while inflight:
+                s0, f = inflight.popleft()
+                yield (s0, *f.result())
+
+    def _device_statuses(self, resources: List[dict],
+                         contexts: Optional[List[dict]] = None):
+        parts = list(self._device_status_chunks(resources, contexts))
+        if len(parts) == 1:
+            return parts[0][1:]
+        return tuple(np.concatenate([p[i] for p in parts])
+                     for i in range(1, 4))
 
     def scan_statuses(self, resources: List[dict]):
         """Raw (status, detail, match) matrices over all compiled programs
         — the allocation-free fast path for throughput measurement and
         report aggregation."""
         wrapped = [Resource(r) for r in resources]
-        status, detail = self._device_statuses(resources)
+        status, detail, _ = self._device_statuses(resources)
         match = self.match_matrix(resources, wrapped)
         return status, detail, match
 
@@ -242,9 +379,9 @@ class BatchScanner:
         # (engine.py:174 apply_background_checks) only applies to scans
         background_mode = admission is None and pctx_factory is None
         wrapped = [Resource(r) for r in resources]
-        status, detail = self._device_statuses(resources, contexts)
         match = self.match_matrix(resources, wrapped, admission)
         now = time.time()
+        ts = int(now)
 
         # which host policies could match each resource at all (group
         # screen over their simple rules; non-simple rules force a run);
@@ -253,63 +390,85 @@ class BatchScanner:
             if background_mode else \
             {p: None for p in self._host_policy_idx}
 
+        progs = self.cps.programs
+        dev_mask = np.zeros(len(progs), bool)
+        for j, _ in self.device_programs:
+            dev_mask[j] = True
+        background_ok = np.array([
+            self.policies[p.policy_index].background for p in progs])
+
         out: List[List[EngineResponse]] = []
-        for i, res_doc in enumerate(resources):
-            responses: Dict[int, EngineResponse] = {}
-            for j, prog in self.device_programs:
-                if not match[i, j]:
-                    continue
-                policy = self.policies[prog.policy_index]
-                if background_mode and not policy.background:
-                    # background-disabled policies contribute an empty
-                    # response (engine.py:174 apply_background_checks)
-                    if prog.policy_index not in responses:
-                        responses[prog.policy_index] = \
-                            self._new_response(prog.policy_index, res_doc, now)
-                    continue
-                resp = responses.get(prog.policy_index)
-                if resp is None:
-                    resp = self._new_response(prog.policy_index, res_doc, now)
-                    responses[prog.policy_index] = resp
-                st = int(status[i, j])
-                if st == STATUS_PASS:
-                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                      prog.pass_messages[int(detail[i, j])],
-                                      RuleStatus.PASS)
-                    if prog.pss is not None:
-                        rr.pod_security_checks = {
-                            'level': prog.pss[0], 'version': prog.pss[1],
-                            'checks': []}
-                elif st == STATUS_SKIP_PRECOND:
-                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                      PRECONDITIONS_SKIP_MESSAGE,
-                                      RuleStatus.SKIP)
-                elif st == STATUS_VAR_ERR:
-                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                      prog.error_messages[int(detail[i, j])],
-                                      RuleStatus.ERROR)
-                elif st == STATUS_SKIP and prog.skip_message is not None:
-                    # foreach 'rule skipped' is a static message
-                    rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                      prog.skip_message, RuleStatus.SKIP)
-                else:
-                    # FAIL / anchor-SKIP / HOST: re-run this rule on the
-                    # host for the exact status + message
-                    rr = self._materialize(prog, res_doc)
-                    if rr is None:
+        # the device chunks stream through while this loop assembles —
+        # three pipeline stages (encode / device / assemble) overlap
+        for start, status, detail, fdet in \
+                self._device_status_chunks(resources, contexts):
+            for k in range(status.shape[0]):
+                i = start + k
+                res_doc = resources[i]
+                responses: Dict[int, EngineResponse] = {}
+                for j in np.nonzero(match[i] & dev_mask)[0]:
+                    j = int(j)
+                    prog = progs[j]
+                    if background_mode and not background_ok[j]:
+                        # background-disabled policies contribute an empty
+                        # response (engine.py:174 apply_background_checks)
+                        if prog.policy_index not in responses:
+                            responses[prog.policy_index] = \
+                                self._new_response(prog.policy_index,
+                                                   res_doc, now, wrapped[i])
                         continue
-                rr.timestamp = int(now)
-                resp.policy_response.rules.append(rr)
-                if rr.status in (RuleStatus.PASS, RuleStatus.FAIL):
-                    resp.policy_response.rules_applied_count += 1
-                elif rr.status == RuleStatus.ERROR:
-                    resp.policy_response.rules_error_count += 1
-            for p_idx in self._host_policy_idx:
-                if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
-                    responses[p_idx] = self._host_run(p_idx, res_doc)
-                else:
-                    responses[p_idx] = self._new_response(p_idx, res_doc, now)
-            out.append([responses[k] for k in sorted(responses)])
+                    resp = responses.get(prog.policy_index)
+                    if resp is None:
+                        resp = self._new_response(prog.policy_index, res_doc,
+                                                  now, wrapped[i])
+                        responses[prog.policy_index] = resp
+                    st = int(status[k, j])
+                    if st == STATUS_PASS:
+                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                          prog.pass_messages[int(detail[k, j])],
+                                          RuleStatus.PASS)
+                        if prog.pss is not None:
+                            rr.pod_security_checks = {
+                                'level': prog.pss[0], 'version': prog.pss[1],
+                                'checks': []}
+                    elif st == STATUS_SKIP_PRECOND:
+                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                          PRECONDITIONS_SKIP_MESSAGE,
+                                          RuleStatus.SKIP)
+                    elif st == STATUS_VAR_ERR:
+                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                          prog.error_messages[int(detail[k, j])],
+                                          RuleStatus.ERROR)
+                    elif st == STATUS_SKIP and prog.skip_message is not None:
+                        # foreach 'rule skipped' is a static message
+                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                          prog.skip_message, RuleStatus.SKIP)
+                    elif st == STATUS_FAIL and \
+                            (msg := self._fail_message_cached(
+                                prog, j, fdet[k])) is not None:
+                        # device-decided FAIL with a synthesizable message
+                        # (static message + fail-site path template)
+                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                          msg, RuleStatus.FAIL)
+                    else:
+                        # anchor-SKIP / HOST / unsynthesizable FAIL: re-run
+                        # this rule on the host for the exact status+message
+                        rr = self._materialize(prog, res_doc)
+                        if rr is None:
+                            continue
+                    rr.timestamp = ts
+                    resp.policy_response.rules.append(rr)
+                    if rr.status in (RuleStatus.PASS, RuleStatus.FAIL):
+                        resp.policy_response.rules_applied_count += 1
+                    elif rr.status == RuleStatus.ERROR:
+                        resp.policy_response.rules_error_count += 1
+                for p_idx in self._host_policy_idx:
+                    if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
+                        responses[p_idx] = self._host_run(p_idx, res_doc)
+                    else:
+                        responses[p_idx] = self._new_response(
+                            p_idx, res_doc, now, wrapped[i])
+                out.append([responses[q] for q in sorted(responses)])
         return out
 
     def _host_policy_maybe(self, resources, wrapped):
@@ -340,6 +499,70 @@ class BatchScanner:
             maybe[p_idx] = flags
         return maybe
 
+    @staticmethod
+    def _site_path(sites: Tuple[str, ...], fd: int) -> str:
+        tmpl = sites[fd >> 16]
+        if '{' in tmpl:
+            tmpl = tmpl.replace('{e0}', str(fd & 0xFF)) \
+                       .replace('{e1}', str((fd >> 8) & 0xFF))
+        return tmpl
+
+    def _fail_message_cached(self, prog: RuleProgram, j: int,
+                             fdet_row) -> Optional[str]:
+        """Memoized message synthesis: distinct (program, fail-detail)
+        combinations are few, so scans hit the cache almost always."""
+        meta = self._evaluator.any_meta.get(j) \
+            if prog.any_fail_sites is not None else None
+        if meta is not None:
+            p = len(self.cps.programs)
+            key = (j,) + tuple(
+                int(x) for x in fdet_row[p + meta[0]:p + meta[0] + meta[1]])
+        else:
+            key = (j, int(fdet_row[j]))
+        cache = self._fail_msg_cache
+        if key in cache:
+            return cache[key]
+        v = self._fail_message(prog, j, fdet_row)
+        if len(cache) > 65536:
+            cache.clear()
+        cache[key] = v
+        return v
+
+    def _fail_message(self, prog: RuleProgram, j: int,
+                      fdet_row) -> Optional[str]:
+        """Synthesize the exact host FAIL message from compile-time
+        templates, or None when this FAIL needs host materialization.
+        (reference formats: pkg/engine/validation.go:722 buildErrorMessage,
+        validation.go:460 getDenyMessage, validation.go:746
+        buildAnyPatternErrorMessage)."""
+        if prog.any_fail_sites is not None:
+            meta = self._evaluator.any_meta.get(j)
+            if meta is None:
+                return None
+            base, n_children = meta
+            p = len(self.cps.programs)
+            parts = []
+            for c in range(n_children):
+                fd_c = int(fdet_row[p + base + c])
+                if fd_c == -2:
+                    continue  # skipped sub-pattern: omitted from message
+                if fd_c < 0:
+                    return None
+                path = self._site_path(prog.any_fail_sites[c], fd_c)
+                parts.append(f'rule {prog.rule_name}[{c}] failed at '
+                             f'path {path}')
+            if not parts or prog.any_fail_prefix is None:
+                return None
+            return prog.any_fail_prefix + ' '.join(parts)
+        fd = int(fdet_row[j])
+        if fd < 0:
+            return None
+        if prog.deny_fail_message is not None:
+            return prog.deny_fail_message
+        if prog.fail_prefix is None or prog.fail_sites is None:
+            return None
+        return prog.fail_prefix + self._site_path(prog.fail_sites, fd)
+
     def _pctx(self, policy: Policy, resource: dict) -> PolicyContext:
         factory = getattr(self, '_pctx_factory', None)
         if factory is not None:
@@ -358,20 +581,21 @@ class BatchScanner:
         return Validator(self.engine, pctx, rule).validate()
 
     def _new_response(self, policy_index: int, resource: dict,
-                      now: float) -> EngineResponse:
-        policy = self.policies[policy_index]
+                      now: float,
+                      wrapped: Optional[Resource] = None) -> EngineResponse:
+        policy, name, namespace, vfa, vfa_overrides = \
+            self._policy_header[policy_index]
         resp = EngineResponse(policy, patched_resource=resource)
         pr = resp.policy_response
-        pr.policy_name = policy.name
-        pr.policy_namespace = policy.namespace
-        r = Resource(resource)
+        pr.policy_name = name
+        pr.policy_namespace = namespace
+        r = wrapped if wrapped is not None else Resource(resource)
         pr.resource_name = r.name
         pr.resource_namespace = r.namespace
         pr.resource_kind = r.kind
         pr.resource_api_version = r.api_version
-        pr.validation_failure_action = policy.validation_failure_action
-        pr.validation_failure_action_overrides = \
-            policy.validation_failure_action_overrides
+        pr.validation_failure_action = vfa
+        pr.validation_failure_action_overrides = vfa_overrides
         pr.timestamp = int(now)
         return resp
 

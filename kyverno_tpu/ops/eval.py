@@ -1,7 +1,7 @@
 """Batched rule evaluation on device (IR v2: tri-state status programs).
 
 ``build_evaluator(cps)`` returns a jitted function mapping the encoded
-batch tensors to ``(status [R, P], detail [R, P])`` int8 matrices for the
+batch tensors to ``(status [R, P], detail [R, P], fdet [R, P])`` matrices for the
 compiled programs, where status is one of
 
   0 PASS   1 FAIL   2 SKIP   3 HOST   4 SKIP_PRECOND
@@ -24,6 +24,7 @@ never sets either bit and surfaces as HOST.
 from __future__ import annotations
 
 import json as _json
+import os
 from fractions import Fraction
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -1135,7 +1136,35 @@ def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
 # ---------------------------------------------------------------------------
 # evaluator assembly
 
+_PERSISTENT_CACHE_ON = False
+
+
+def enable_persistent_compilation_cache() -> Optional[str]:
+    """Point XLA's persistent compilation cache at a disk directory so a
+    fresh process re-serving the same policy set skips the (multi-second)
+    evaluator compile.  Keyed by XLA on the computation fingerprint, which
+    covers the (policy-set, chunk-shape) pair.  Idempotent; returns the
+    cache dir (or None when the runtime lacks the knobs)."""
+    global _PERSISTENT_CACHE_ON
+    cache_dir = os.environ.get(
+        'KTPU_COMPILE_CACHE',
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), '.cache', 'xla'))
+    if _PERSISTENT_CACHE_ON:
+        return cache_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return None
+    _PERSISTENT_CACHE_ON = True
+    return cache_dir
+
+
 def build_evaluator(cps: CompiledPolicySet):
+    enable_persistent_compilation_cache()
     slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
     gather_prefix = {g: f'g{k}' for k, g in enumerate(cps.gathers)}
     elem_prefix = {g: f'e{k}' for k, g in enumerate(cps.elem_gathers)}
@@ -1158,6 +1187,20 @@ def build_evaluator(cps: CompiledPolicySet):
 
     leaf_cache: Dict[Tuple[Leaf, int], _K] = {}
     cond_cache: Dict[CondCheck, _K] = {}
+    # per-trace accumulator of anyPattern child fail channels; the static
+    # column map (program index → (aux base, n children)) is derived from
+    # the programs so callers can index the fdet output past the P main
+    # columns without waiting for a trace
+    aux_acc: List[Any] = []
+    any_meta: Dict[int, Tuple[int, int]] = {}
+    _aux_cols = 0
+    for _j, _prog in enumerate(cps.programs):
+        _units = _prog.status.children if _prog.status.kind == 'seq' \
+            else (_prog.status,)
+        for _u in _units:
+            if _u.kind == 'any':
+                any_meta[_j] = (_aux_cols, len(_u.children))
+                _aux_cols += len(_u.children)
 
     def eval_leaf(t, leaf: Leaf, depth: int) -> _K:
         key = (leaf, depth)
@@ -1264,20 +1307,34 @@ def build_evaluator(cps: CompiledPolicySet):
                          jnp.where(k.f, jnp.int8(false_code),
                                    jnp.int8(HOST))).astype(jnp.int8)
 
+    def site_fd(node: StatusExpr, ref):
+        """Constant fail-detail plane for a node with a static fail site
+        (site id in the high bits, element bytes zeroed)."""
+        if node.fail_site is None:
+            return jnp.full(ref.shape, -1, jnp.int32)
+        return jnp.full(ref.shape, node.fail_site << 16, jnp.int32)
+
     def eval_status(t, node: StatusExpr, depth: int):
-        """Returns (status int8 [R]+[E]*depth, detail int8 same shape)."""
+        """Returns (status int8, detail int8, fdet int32), each
+        [R]+[E]*depth.  ``fdet`` identifies, for FAIL statuses, the walk
+        position the host would report: site id in bits 16+, the
+        outer/inner element indices in bytes 0/1; -1 = a FAIL here has no
+        synthesizable message (host re-run)."""
         def zd(ref):
             return jnp.zeros(ref.shape, jnp.int8)
+
+        def nofd(ref):
+            return jnp.full(ref.shape, -1, jnp.int32)
 
         kind = node.kind
         if kind == 'const':
             n = t[next(iter(t))].shape[0]
             shape = (n,) + (dims['E'],) * depth
             s = jnp.full(shape, node.operand, jnp.int8)
-            return s, jnp.zeros(shape, jnp.int8)
+            return s, jnp.zeros(shape, jnp.int8), nofd(s)
         if kind == 'leaf':
             s = from_k(eval_expr(t, node.expr, depth), PASS, FAIL)
-            return s, zd(s)
+            return s, zd(s), site_fd(node, s)
         if kind in ('precond', 'deny'):
             if kind == 'precond':
                 s = from_k(eval_expr(t, node.expr, depth), PASS, SKIPP)
@@ -1292,17 +1349,29 @@ def build_evaluator(cps: CompiledPolicySet):
                 hit = nf & (s != STATUS_VAR_ERR)
                 s = jnp.where(hit, jnp.int8(STATUS_VAR_ERR), s)
                 d = jnp.where(hit, jnp.int8(msg_idx), d)
-            return s, d
+            # deny FAILs carry a static message (site-free): fdet 0 marks
+            # 'synthesizable'; preconditions never FAIL
+            fd = jnp.zeros(s.shape, jnp.int32) if kind == 'deny' else nofd(s)
+            return s, d, fd
+        if kind == 'failguard':
+            # fdet-only guard: sub status unchanged; the fail path/message
+            # is synthesizable only while every tracked anchor key is
+            # present (else the host reports the empty-path message form)
+            sub_s, sub_d, sub_fd = eval_status(t, node.sub, depth)
+            g = eval_expr(t, node.expr, depth)
+            return sub_s, sub_d, jnp.where(g.t, sub_fd, jnp.int32(-1))
         if kind == 'seq':
-            s, d = eval_status(t, node.children[0], depth)
+            s, d, fd = eval_status(t, node.children[0], depth)
             for c in node.children[1:]:
-                cs, cd = eval_status(t, c, depth)
+                cs, cd, cfd = eval_status(t, c, depth)
                 take = s == PASS
                 s = jnp.where(take, cs, s)
                 d = jnp.where(take, cd, d)
-            return s, d
+                fd = jnp.where(take, cfd, fd)
+            return s, d, fd
         if kind == 'any':
-            stats = [eval_status(t, c, depth)[0] for c in node.children]
+            evals = [eval_status(t, c, depth) for c in node.children]
+            stats = [e[0] for e in evals]
             ref = stats[0]
             taken = jnp.zeros(ref.shape, bool)
             pending_host = jnp.zeros(ref.shape, bool)
@@ -1319,7 +1388,15 @@ def build_evaluator(cps: CompiledPolicySet):
                 jnp.where(pending_host, jnp.int8(HOST),
                           jnp.where(all_skip, jnp.int8(SKIP),
                                     jnp.int8(FAIL)))).astype(jnp.int8)
-            return out, detail
+            # per-child fail channels for anyPattern message synthesis:
+            # on an overall FAIL every child is FAIL or SKIP; -2 marks a
+            # skipped child (omitted from the message), -1 an
+            # unsynthesizable child failure
+            for s_i, _, fd_i in evals:
+                aux_acc.append(jnp.where(
+                    s_i == SKIP, jnp.int32(-2),
+                    jnp.where(s_i == FAIL, fd_i, jnp.int32(-1))))
+            return out, detail, nofd(out)
         if kind in ('cond', 'global', 'equality', 'negation'):
             view = _View(t, slot_prefix[node.slot])
             present = view.tag != TAG_MISSING
@@ -1328,11 +1405,11 @@ def build_evaluator(cps: CompiledPolicySet):
             if kind == 'negation':
                 s = jnp.where(present, jnp.int8(FAIL),
                               jnp.int8(PASS)).astype(jnp.int8)
-                return s, zd(s)
-            sub_s, sub_d = eval_status(t, node.sub, depth)
+                return s, zd(s), site_fd(node, s)
+            sub_s, sub_d, sub_fd = eval_status(t, node.sub, depth)
             if kind == 'equality':
                 s = jnp.where(present, sub_s, jnp.int8(PASS)).astype(jnp.int8)
-                return s, sub_d
+                return s, sub_d, sub_fd
             # cond: absent→SKIP; sub FAIL/SKIP→SKIP; HOST→HOST
             # global: absent→PASS; sub FAIL/SKIP→SKIP; HOST→HOST
             absent_code = SKIP if kind == 'cond' else PASS
@@ -1342,7 +1419,7 @@ def build_evaluator(cps: CompiledPolicySet):
                 ~present, jnp.int8(absent_code),
                 jnp.where(sub_s == PASS, jnp.int8(PASS),
                           nonpass)).astype(jnp.int8)
-            return s, zd(s)
+            return s, zd(s), nofd(s)
         if kind in ('forall', 'exists', 'scalars'):
             ap = array_prefix[node.slot.path]
             arr_tag = t[f'{ap}_tag']
@@ -1350,6 +1427,8 @@ def build_evaluator(cps: CompiledPolicySet):
             ovf = t[f'{ap}_overflow']
             valid = jnp.arange(dims['E']) < count[..., None]
             if kind == 'scalars':
+                # scalar-vs-array failures report the ARRAY's path
+                # (validate_pattern.py:61-66), so fdet needs no element
                 k = eval_expr(t, node.expr, depth + 1)
                 any_fail = jnp.any(valid & k.f, axis=-1)
                 any_unk = jnp.any(valid & k.unknown(), axis=-1) | ovf
@@ -1358,11 +1437,12 @@ def build_evaluator(cps: CompiledPolicySet):
                     jnp.where(any_fail, jnp.int8(FAIL),
                               jnp.where(any_unk, jnp.int8(HOST),
                                         jnp.int8(PASS)))).astype(jnp.int8)
-                return s, zd(s)
-            sub_s, _ = eval_status(t, node.sub, depth + 1)
+                return s, zd(s), site_fd(node, s)
+            sub_s, _, sub_fd = eval_status(t, node.sub, depth + 1)
             if kind == 'exists':
                 # reference: pkg/engine/anchor/handlers.go:228 — missing
-                # key passes, non-list fails, ≥1 element must validate
+                # key passes, non-list fails, ≥1 element must validate;
+                # both failure modes report the anchored key's path
                 satisfied = jnp.any(valid & (sub_s == PASS), axis=-1)
                 maybe = jnp.any(valid & (sub_s == HOST), axis=-1) | ovf
                 s = jnp.where(
@@ -1371,9 +1451,10 @@ def build_evaluator(cps: CompiledPolicySet):
                               jnp.where(satisfied, jnp.int8(PASS),
                                         jnp.where(maybe, jnp.int8(HOST),
                                                   jnp.int8(FAIL)))))
-                return s.astype(jnp.int8), zd(s)
+                return s.astype(jnp.int8), zd(s), site_fd(node, s)
             # forall (validateArrayOfMaps, validate.go:218)
-            any_fail = jnp.any(valid & (sub_s == FAIL), axis=-1)
+            fail_at = valid & (sub_s == FAIL)
+            any_fail = jnp.any(fail_at, axis=-1)
             any_host = jnp.any(valid & (sub_s == HOST), axis=-1) | ovf
             any_skip = jnp.any(valid & (sub_s == SKIP), axis=-1)
             any_pass = jnp.any(valid & (sub_s == PASS), axis=-1)
@@ -1384,7 +1465,19 @@ def build_evaluator(cps: CompiledPolicySet):
                                     jnp.where(any_skip & ~any_pass,
                                               jnp.int8(SKIP),
                                               jnp.int8(PASS)))))
-            return s.astype(jnp.int8), zd(s)
+            # the host raises on the FIRST failing element in index order
+            # (validate_pattern.py:136); an undecidable element BEFORE it
+            # could itself be the true first failure → path ambiguous
+            idx = jnp.argmax(fail_at, axis=-1)
+            before = jnp.arange(dims['E']) < idx[..., None]
+            ambiguous = jnp.any(before & valid & (sub_s == HOST), axis=-1)
+            sel = jnp.take_along_axis(
+                sub_fd, idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            elem_fd = jnp.where(
+                ambiguous | (sel < 0), jnp.int32(-1),
+                sel | (idx.astype(jnp.int32) << (8 * depth)))
+            fd = jnp.where(arr_tag != TAG_ARRAY, site_fd(node, s), elem_fd)
+            return s.astype(jnp.int8), zd(s), fd
         if kind == 'foreach':
             # engine.py:611 _validate_foreach: entries in order; the
             # first non-pass element outcome decides; zero applied
@@ -1393,6 +1486,12 @@ def build_evaluator(cps: CompiledPolicySet):
             nonpass = jnp.zeros(n, bool)
             unknown = jnp.zeros(n, bool)
             apply_any = jnp.zeros(n, bool)
+            # fd_ok: the FIRST entry with any non-pass/unknown outcome
+            # decided by a deny-condition element fail — its message is the
+            # static 'validation failure: …'; a last-index ERROR element or
+            # an earlier undecidable entry makes the outcome/message
+            # ambiguous (engine.py:663 error-continue semantics)
+            fd_ok = jnp.zeros(n, bool)
             for entry in node.operand:
                 lp = gather_prefix[entry.list_gather]
                 lkind = t[f'{lp}_kind']
@@ -1434,6 +1533,7 @@ def build_evaluator(cps: CompiledPolicySet):
                     jnp.any(valid & e_unknown, axis=-1) | lovf) & \
                     ~entry_nonpass
                 entry_apply = active & jnp.any(valid & e_pass, axis=-1)
+                fd_ok = fd_ok | (~(nonpass | unknown) & active & any_fail)
                 nonpass = nonpass | entry_nonpass
                 unknown = unknown | entry_unknown
                 apply_any = apply_any | entry_apply
@@ -1442,36 +1542,44 @@ def build_evaluator(cps: CompiledPolicySet):
                 jnp.where(unknown, jnp.int8(HOST),
                           jnp.where(apply_any, jnp.int8(PASS),
                                     jnp.int8(SKIP)))).astype(jnp.int8)
-            return s, jnp.zeros(n, jnp.int8)
+            fd = jnp.where(fd_ok, jnp.int32(0), jnp.int32(-1))
+            return s, jnp.zeros(n, jnp.int8), fd
         if kind == 'trackfail':
-            sub_s, sub_d = eval_status(t, node.sub, depth)
+            sub_s, sub_d, sub_fd = eval_status(t, node.sub, depth)
             guard = eval_expr(t, node.expr, depth)
             s = jnp.where(sub_s == FAIL,
                           jnp.where(guard.t, jnp.int8(FAIL),
                                     jnp.int8(HOST)),
                           sub_s).astype(jnp.int8)
-            return s, sub_d
+            return s, sub_d, sub_fd
         raise ValueError(f'unknown status kind {kind!r}')
 
     def evaluate(t: Dict[str, jnp.ndarray]):
         leaf_cache.clear()
         cond_cache.clear()
+        aux_acc.clear()
         # element width of this batch (dynamic; see encode._measure_elems)
         # — probed from slot ('sN_') or array ('aN_') tags, not gathers
         dims['E'] = next(
             (arr.shape[1] for name, arr in sorted(t.items())
              if name.endswith('_tag') and arr.ndim >= 2
              and name[0] in 'sa'), 0)
-        cols, dets = [], []
+        cols, dets, fds = [], [], []
         for prog in cps.programs:
-            s, d = eval_status(t, prog.status, 0)
+            s, d, fd = eval_status(t, prog.status, 0)
             cols.append(s)
             dets.append(d)
+            fds.append(fd)
         if not cols:
             n = t[next(iter(t))].shape[0] if t else 0
             z = jnp.zeros((n, 0), jnp.int8)
-            return z, z
-        return jnp.stack(cols, axis=1), jnp.stack(dets, axis=1)
+            return z, z, jnp.zeros((n, 0), jnp.int32)
+        fdet = jnp.stack(fds, axis=1)
+        if aux_acc:
+            # anyPattern child channels live past the P main columns
+            fdet = jnp.concatenate(
+                [fdet, jnp.stack(list(aux_acc), axis=1)], axis=1)
+        return jnp.stack(cols, axis=1), jnp.stack(dets, axis=1), fdet
 
     layout_holder: Dict[str, Any] = {'layout': None}
 
@@ -1491,6 +1599,7 @@ def build_evaluator(cps: CompiledPolicySet):
     call.jitted = jitted
     call.raw = evaluate
     call.layout_holder = layout_holder
+    call.any_meta = any_meta
     return call
 
 
