@@ -39,6 +39,12 @@ class AdmissionController:
         self.handlers = ResourceHandlers(
             self.cache, configuration=setup.configuration,
             ur_sink=self._create_ur)
+        # CRD schema ingestion feeding the mutation schema checks
+        # (reference: pkg/controllers/openapi/controller.go:148)
+        from ..controllers.openapi import OpenAPIController
+        self.openapi_controller = OpenAPIController(
+            setup.client, self.handlers.openapi_manager)
+        self.openapi_controller.reconcile()
         self.server = WebhookServer(
             self.handlers, configuration=setup.configuration,
             port=port, certfile=certfile, keyfile=keyfile)
@@ -71,6 +77,7 @@ class AdmissionController:
 
     def tick(self) -> None:
         policies = self.sync_policies()
+        self.openapi_controller.reconcile()
         is_leader = mesh_is_leader() and (
             self.elector is None or self.elector.is_leader())
         if is_leader:

@@ -29,6 +29,11 @@ def base_parser(name: str) -> argparse.ArgumentParser:
     p.add_argument('--metrics-port', type=int, default=8000)
     p.add_argument('--disable-metrics', action='store_true')
     p.add_argument('--leader-election', action='store_true')
+    # reference: cmd/internal/flag.go:40-42 (-profile/-profilePort) and
+    # :46-49 (enableTracing/tracingAddress/tracingPort)
+    p.add_argument('--profile', action='store_true')
+    p.add_argument('--profile-port', type=int, default=6060)
+    p.add_argument('--enable-tracing', action='store_true')
     p.add_argument('--kubeconfig', default='',
                    help='unused with the in-memory client; reserved for '
                         'a real cluster transport')
@@ -54,6 +59,16 @@ class Setup:
             client = FakeClient()
         self.client = client
         self.stop_event = threading.Event()
+        # profiling + tracing (reference: setup.go:21 setup order)
+        self.profiling_server = None
+        if getattr(self.options, 'profile', False):
+            from ..observability.profiling import ProfilingServer
+            self.profiling_server = ProfilingServer(
+                self.options.profile_port)
+            self.profiling_server.start()
+        if getattr(self.options, 'enable_tracing', False):
+            from ..observability import tracing
+            tracing.configure()
 
     def install_signal_handlers(self) -> None:
         def handler(signum, frame):

@@ -374,6 +374,15 @@ class BatchScanner:
         n = len(resources)
         if n == 0:
             return []
+        from ..observability import tracing
+        with tracing.start_span(
+                'kyverno/device/scan',
+                {'resources': n, 'programs': len(self.cps.programs)}):
+            return self._scan_inner(resources, contexts, admission,
+                                    pctx_factory)
+
+    def _scan_inner(self, resources, contexts, admission, pctx_factory):
+        n = len(resources)
         self._pctx_factory = pctx_factory
         # admission scans evaluate every policy; the background gate
         # (engine.py:174 apply_background_checks) only applies to scans

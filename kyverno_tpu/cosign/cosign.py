@@ -1,18 +1,40 @@
-"""Cosign-style verification against a registry client (reference:
+"""Cosign verification against a registry client (reference:
 pkg/cosign/cosign.go:63 VerifySignature, :256 FetchAttestations).
 
-A signature entry matches when the attestor's key id equals the stored
-key (static keys), or its subject/issuer match (keyless) — wildcards
-allowed, the same matching the reference performs on certificate
-identity.
+Real signature cryptography over the cosign "simple signing" model:
+
+* a signature entry carries ``payload`` (base64 JSON) + ``signature``
+  (base64 over the payload bytes) and optionally a signing ``cert`` (+
+  ``chain``) for keyless flows;
+* static-key attestors verify the signature with the provided PEM public
+  key (ECDSA P-256/P-384 SHA-256, RSA PKCS1v15 SHA-256, or Ed25519);
+* keyless attestors verify the leaf certificate chains to the provided
+  roots, verify the payload signature with the leaf's public key, and
+  match the certificate identity — SAN email/URI vs ``subject``, the
+  Fulcio OIDC-issuer extension (1.3.6.1.4.1.57264.1.1) vs ``issuer`` —
+  with the same wildcard semantics the reference applies;
+* the payload's ``critical.image.docker-manifest-digest`` must equal the
+  image's digest, and attestor ``annotations`` must be present in the
+  payload's ``optional`` block (cosign.go payload checks).
+
+Rekor tlog checks are represented by ``ignore_tlog`` only: the hermetic
+environment has no transparency log, and entries carry no bundle.
+
+Legacy metadata-only entries (a bare ``key`` id, no payload) remain
+accepted ONLY when the attestor key is not a PEM block — the CLI mock
+registry uses those; any PEM-keyed attestor requires real signatures.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import base64
+import json
+from typing import List, Optional, Tuple
 
 from ..utils import wildcard
 from ..registry.client import RegistryError
+
+_FULCIO_ISSUER_OID = '1.3.6.1.4.1.57264.1.1'
 
 
 class Options:
@@ -54,6 +76,203 @@ class Response:
         self.statements = statements or []
 
 
+class VerificationError(Exception):
+    """One signature entry failed cryptographic verification."""
+
+
+def _is_pem(blob: str) -> bool:
+    return isinstance(blob, str) and '-----BEGIN' in blob
+
+
+# ---------------------------------------------------------------------------
+# crypto primitives
+
+def _load_public_key(pem: str):
+    from cryptography.hazmat.primitives import serialization
+    try:
+        return serialization.load_pem_public_key(pem.strip().encode())
+    except Exception as e:  # noqa: BLE001
+        raise VerificationError(f'bad public key: {e}') from e
+
+
+def _verify_blob(public_key, signature: bytes, payload: bytes) -> None:
+    """Verify ``signature`` over ``payload`` for the supported key types
+    (cosign defaults: ECDSA-SHA256; RSA PKCS1v15-SHA256; Ed25519)."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import (ec, ed25519,
+                                                           padding, rsa)
+    try:
+        if isinstance(public_key, ec.EllipticCurvePublicKey):
+            public_key.verify(signature, payload,
+                              ec.ECDSA(hashes.SHA256()))
+        elif isinstance(public_key, rsa.RSAPublicKey):
+            public_key.verify(signature, payload, padding.PKCS1v15(),
+                              hashes.SHA256())
+        elif isinstance(public_key, ed25519.Ed25519PublicKey):
+            public_key.verify(signature, payload)
+        else:
+            raise VerificationError(
+                f'unsupported key type {type(public_key).__name__}')
+    except InvalidSignature as e:
+        raise VerificationError('signature verification failed') from e
+
+
+def _load_certs(pem_blob: str) -> List:
+    from cryptography import x509
+    certs = []
+    block: List[str] = []
+    for line in (pem_blob or '').splitlines():
+        block.append(line)
+        if '-----END CERTIFICATE-----' in line:
+            try:
+                certs.append(x509.load_pem_x509_certificate(
+                    '\n'.join(block).encode()))
+            except Exception as e:  # noqa: BLE001 - registry data is
+                # untrusted; a malformed cert must fail only this entry
+                raise VerificationError(f'bad certificate: {e}') from e
+            block = []
+    return certs
+
+
+def _verify_cert_chain(leaf, intermediates: List, roots: List) -> None:
+    """Walk issuer links from the leaf to any of ``roots``, verifying
+    each certificate's signature with its issuer's public key
+    (cosign.go cert verification against the provided root pool)."""
+    if not roots:
+        raise VerificationError('no roots provided for certificate chain')
+    pool = {c.subject.rfc4514_string(): c for c in intermediates}
+    root_by_subject = {c.subject.rfc4514_string(): c for c in roots}
+    current = leaf
+    for _hop in range(len(intermediates) + 2):
+        issuer_name = current.issuer.rfc4514_string()
+        issuer = root_by_subject.get(issuer_name)
+        terminal = issuer is not None
+        if issuer is None:
+            issuer = pool.get(issuer_name)
+        if issuer is None:
+            raise VerificationError(
+                f'certificate chain broken at issuer {issuer_name!r}')
+        try:
+            current.verify_directly_issued_by(issuer)
+        except Exception as e:  # noqa: BLE001
+            raise VerificationError(
+                f'certificate signature invalid: {e}') from e
+        if terminal:
+            return
+        current = issuer
+    raise VerificationError('certificate chain too long')
+
+
+def _cert_identities(cert) -> Tuple[List[str], str]:
+    """(SAN subjects, OIDC issuer) of a Fulcio-style signing cert."""
+    from cryptography import x509
+    subjects: List[str] = []
+    try:
+        san = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        subjects += san.get_values_for_type(x509.RFC822Name)
+        subjects += [str(u) for u in san.get_values_for_type(
+            x509.UniformResourceIdentifier)]
+    except x509.ExtensionNotFound:
+        pass
+    issuer = ''
+    for ext in cert.extensions:
+        if ext.oid.dotted_string == _FULCIO_ISSUER_OID:
+            raw = ext.value.value if hasattr(ext.value, 'value') else b''
+            issuer = raw.decode('utf-8', 'replace') if raw else ''
+    return subjects, issuer
+
+
+# ---------------------------------------------------------------------------
+# payload checks (cosign simple-signing)
+
+def _check_payload(payload: bytes, digest: str, opts: Options) -> None:
+    try:
+        doc = json.loads(payload)
+    except ValueError as e:
+        raise VerificationError(f'malformed signature payload: {e}') from e
+    got = ((doc.get('critical') or {}).get('image') or {}).get(
+        'docker-manifest-digest', '')
+    if got != digest:
+        raise VerificationError(
+            f'payload digest {got!r} does not match image digest {digest!r}')
+    optional = doc.get('optional') or {}
+    for k, v in opts.annotations.items():
+        if optional.get(k) != v:
+            raise VerificationError(f'annotation {k!r} mismatch')
+
+
+def _verify_crypto_sig(sig: dict, payload: bytes, signature: bytes,
+                       opts: Options) -> None:
+    """Shared signature + signer verification for signature and
+    attestation entries (keyed or keyless)."""
+    if opts.key:
+        _verify_blob(_load_public_key(opts.key), signature, payload)
+        return
+    if opts.cert:
+        # pinned certificate: the signature MUST verify with the
+        # attestor's cert — an entry-supplied cert is never trusted here
+        certs = _load_certs(opts.cert)
+        if not certs:
+            raise VerificationError('no pinned certificate parsed')
+        leaf = certs[0]
+        roots = _load_certs(opts.roots)
+        if roots:
+            _verify_cert_chain(leaf,
+                               certs[1:] + _load_certs(opts.cert_chain),
+                               roots)
+    else:
+        # Fulcio-style keyless: the entry carries its signing cert, which
+        # must chain to the configured roots — without roots there is no
+        # trust anchor at all
+        cert_pem = sig.get('cert', '')
+        if not cert_pem:
+            raise VerificationError('no certificate for keyless entry')
+        certs = _load_certs(cert_pem)
+        if not certs:
+            raise VerificationError('no certificate parsed')
+        leaf = certs[0]
+        roots = _load_certs(opts.roots)
+        if not roots:
+            raise VerificationError(
+                'keyless verification requires roots or a pinned cert')
+        _verify_cert_chain(
+            leaf,
+            certs[1:] + _load_certs(sig.get('chain', '')) +
+            _load_certs(opts.cert_chain),
+            roots)
+    _verify_blob(leaf.public_key(), signature, payload)
+    subjects, issuer = _cert_identities(leaf)
+    if opts.subject and not any(
+            wildcard.match(opts.subject, s) for s in subjects):
+        raise VerificationError(
+            f'certificate subjects {subjects} do not match '
+            f'{opts.subject!r}')
+    if opts.issuer and not wildcard.match(opts.issuer, issuer):
+        raise VerificationError(
+            f'certificate issuer {issuer!r} does not match '
+            f'{opts.issuer!r}')
+
+
+def _decode_entry(entry: dict) -> Tuple[bytes, bytes]:
+    try:
+        return (base64.b64decode(entry['payload']),
+                base64.b64decode(entry['signature']))
+    except Exception as e:  # noqa: BLE001
+        raise VerificationError(f'undecodable signature entry: {e}') from e
+
+
+def _verify_entry(sig: dict, digest: str, opts: Options) -> None:
+    """Cryptographically verify one stored signature entry."""
+    payload, signature = _decode_entry(sig)
+    _verify_crypto_sig(sig, payload, signature, opts)
+    _check_payload(payload, digest, opts)
+
+
+# ---------------------------------------------------------------------------
+# legacy metadata matching (CLI mock-registry fixtures only)
+
 def _signature_matches(sig: dict, opts: Options) -> bool:
     if opts.key:
         return sig.get('key', '') == opts.key.strip()
@@ -70,28 +289,103 @@ def _signature_matches(sig: dict, opts: Options) -> bool:
     return matched
 
 
+def _is_crypto_entry(sig: dict) -> bool:
+    return 'payload' in sig and 'signature' in sig
+
+
 def verify_signature(rclient, opts: Options) -> Response:
     """reference: cosign.go:63 VerifySignature — raises on no match."""
     signatures = rclient.get_signatures(opts.image_ref)
     digest = rclient.fetch_image_descriptor(opts.image_ref).digest
+    errors: List[str] = []
+    pem_attestor = _is_pem(opts.key) or _is_pem(opts.roots) or \
+        _is_pem(opts.cert)
     for sig in signatures:
-        if _signature_matches(sig, opts):
+        if _is_crypto_entry(sig):
+            try:
+                _verify_entry(sig, digest, opts)
+                return Response(digest=digest)
+            except VerificationError as e:
+                errors.append(str(e))
+                continue
+        elif not pem_attestor and _signature_matches(sig, opts):
+            # legacy metadata entry — only for non-PEM attestor fixtures
             return Response(digest=digest)
+    detail = f': {"; ".join(errors)}' if errors else ''
     raise RegistryError(
-        f'no matching signatures for {opts.image_ref}')
+        f'no matching signatures for {opts.image_ref}{detail}')
 
 
 def fetch_attestations(rclient, opts: Options) -> Response:
     """reference: cosign.go:256 FetchAttestations — returns the in-toto
-    statements whose signer matches the attestor options."""
+    statements whose signer verifies against the attestor options."""
     attestations = rclient.get_attestations(opts.image_ref)
     digest = rclient.fetch_image_descriptor(opts.image_ref).digest
+    pem_attestor = _is_pem(opts.key) or _is_pem(opts.roots) or \
+        _is_pem(opts.cert)
     statements = []
     for att in attestations:
+        if _is_crypto_entry(att):
+            try:
+                payload, signature = _decode_entry(att)
+                _verify_crypto_sig(att, payload, signature, opts)
+                statements.append(json.loads(payload))
+            except VerificationError:
+                pass
+            continue
         sig = {'key': att.get('key', ''), 'subject': att.get('subject', ''),
                'issuer': att.get('issuer', '')}
+        if pem_attestor:
+            continue
         if opts.key or opts.subject or opts.issuer:
             if not _signature_matches(sig, opts):
                 continue
         statements.append(att['statement'])
     return Response(digest=digest, statements=statements)
+
+
+# ---------------------------------------------------------------------------
+# signing helpers (test fixtures / local signing — the produce side of the
+# simple-signing model, mirroring what `cosign sign` writes to a registry)
+
+def make_payload(image_ref: str, digest: str,
+                 annotations: Optional[dict] = None) -> bytes:
+    doc = {
+        'critical': {
+            'identity': {'docker-reference': image_ref.split('@')[0]},
+            'image': {'docker-manifest-digest': digest},
+            'type': 'cosign container image signature',
+        },
+        'optional': annotations or {},
+    }
+    return json.dumps(doc, sort_keys=True, separators=(',', ':')).encode()
+
+
+def sign_payload(private_key, payload: bytes) -> bytes:
+    """Sign payload bytes with a cryptography private key object."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import (ec, ed25519,
+                                                           padding, rsa)
+    if isinstance(private_key, ec.EllipticCurvePrivateKey):
+        return private_key.sign(payload, ec.ECDSA(hashes.SHA256()))
+    if isinstance(private_key, rsa.RSAPrivateKey):
+        return private_key.sign(payload, padding.PKCS1v15(),
+                                hashes.SHA256())
+    if isinstance(private_key, ed25519.Ed25519PrivateKey):
+        return private_key.sign(payload)
+    raise TypeError(f'unsupported key type {type(private_key).__name__}')
+
+
+def signature_entry(private_key, payload: bytes, cert_pem: str = '',
+                    chain_pem: str = '') -> dict:
+    """A registry signature entry as stored by ``cosign sign``."""
+    entry = {
+        'payload': base64.b64encode(payload).decode(),
+        'signature': base64.b64encode(
+            sign_payload(private_key, payload)).decode(),
+    }
+    if cert_pem:
+        entry['cert'] = cert_pem
+    if chain_pem:
+        entry['chain'] = chain_pem
+    return entry

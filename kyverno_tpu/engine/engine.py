@@ -235,11 +235,19 @@ class Engine:
                 if pctx.old_resource and (old_r.namespace != pol_ns or old_r.namespace == ''):
                     return resp
 
+            from ..observability import tracing
             for raw_rule in rules:
                 rule = Rule(raw_rule)
                 pctx.json_context.reset()
                 start = time.time()
-                rule_resp = self._process_rule(pctx, rule)
+                # per-rule child span (reference: pkg/engine/validation.go:139
+                # via pkg/tracing/childspan.go ChildSpan1)
+                with tracing.start_span(
+                        'kyverno/engine/rule',
+                        {'policy': policy.name, 'rule': rule.name}) as span:
+                    rule_resp = self._process_rule(pctx, rule)
+                    if rule_resp is not None:
+                        span.set_attribute('status', rule_resp.status)
                 if rule_resp is not None:
                     self._add_rule_response(resp, rule_resp, start)
                     if apply_rules == 'One' and \

@@ -1,0 +1,125 @@
+"""Profiling endpoint (reference: pkg/profiling/pprof.go; flags
+-profile / -profilePort=6060 at cmd/internal/flag.go:40-42).
+
+Python equivalent of Go's net/http/pprof surface:
+
+* ``/debug/pprof/`` — index
+* ``/debug/pprof/goroutine`` — all live thread stacks (Go's goroutine
+  profile analogue), plain text
+* ``/debug/pprof/profile?seconds=N`` — sampling CPU profile: stacks of
+  every thread sampled at ~100 Hz for N seconds, returned as folded
+  stacks (``frame;frame;frame count`` lines — flamegraph-ready)
+* ``/debug/traces`` — recent spans from the in-memory trace exporter as
+  OTLP-shaped JSON
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def thread_stacks() -> str:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f'thread {tid} ({names.get(tid, "?")}):')
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+        out.append('')
+    return '\n'.join(out)
+
+
+def sample_profile(seconds: float, hz: int = 100) -> str:
+    """Folded-stacks sampling profile across all threads."""
+    counts: Counter = Counter()
+    deadline = time.time() + seconds
+    interval = 1.0 / hz
+    me = threading.get_ident()
+    while time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            frames = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                frames.append(f'{code.co_name} '
+                              f'({code.co_filename.rsplit("/", 1)[-1]}:'
+                              f'{f.f_lineno})')
+                f = f.f_back
+            counts[';'.join(reversed(frames))] += 1
+        time.sleep(interval)
+    return '\n'.join(f'{stack} {n}'
+                     for stack, n in counts.most_common()) or '(idle)\n'
+
+
+class ProfilingServer:
+    """reference: pkg/profiling/pprof.go — starts only with -profile."""
+
+    def __init__(self, port: int = 6060):
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 - quiet
+                pass
+
+            def _send(self, body: str, ctype='text/plain', code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                if parsed.path in ('/debug/pprof', '/debug/pprof/'):
+                    self._send('profiles:\n  goroutine\n  profile\n'
+                               '  traces\n')
+                elif parsed.path == '/debug/pprof/goroutine':
+                    self._send(thread_stacks())
+                elif parsed.path == '/debug/pprof/profile':
+                    q = parse_qs(parsed.query)
+                    try:
+                        seconds = float(q.get('seconds', ['1'])[0])
+                    except ValueError:
+                        self._send('bad seconds parameter', code=400)
+                        return
+                    self._send(sample_profile(min(max(seconds, 0.01),
+                                                  60.0)))
+                elif parsed.path == '/debug/traces':
+                    from . import tracing
+                    mem = tracing.memory_exporter()
+                    spans = [s.to_otlp() for s in mem.spans()] \
+                        if mem is not None else []
+                    self._send(json.dumps({'spans': spans}),
+                               'application/json')
+                else:
+                    self._send('not found', code=404)
+
+        self._httpd = ThreadingHTTPServer(('127.0.0.1', self.port),
+                                          _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name='ktpu-profiling', daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

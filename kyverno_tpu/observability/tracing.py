@@ -1,0 +1,205 @@
+"""Tracing: OTel-shaped spans over engine rule execution and webhook
+handlers (reference: pkg/tracing/config.go NewTraceConfig, span.go,
+childspan.go ChildSpan1 wrapping each rule at pkg/engine/validation.go:139;
+HTTP handler spans at pkg/webhooks/handlers/trace.go:16).
+
+Design: a process tracer with contextvar span propagation and pluggable
+exporters. The in-memory exporter serves tests and the ``/debug/traces``
+endpoint; an OTLP-shaped JSON exporter callback can be attached for a
+collector — the hermetic environment has no network, so export is a
+callable boundary, not a gRPC client.
+
+Tracing is off until :func:`configure` runs (zero overhead: the no-op
+tracer allocates nothing per span).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_current_span: contextvars.ContextVar[Optional['Span']] = \
+    contextvars.ContextVar('ktpu_current_span', default=None)
+
+
+class Span:
+    __slots__ = ('name', 'trace_id', 'span_id', 'parent_id', 'start_ns',
+                 'end_ns', 'attributes', 'status', 'status_message',
+                 '_tracer', '_token')
+
+    def __init__(self, tracer: 'Tracer', name: str,
+                 parent: Optional['Span'],
+                 attributes: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.trace_id = parent.trace_id if parent else secrets.token_hex(16)
+        self.span_id = secrets.token_hex(8)
+        self.parent_id = parent.span_id if parent else ''
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.status = 'unset'
+        self.status_message = ''
+        self._tracer = tracer
+        self._token = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_status(self, status: str, message: str = '') -> None:
+        self.status = status
+        self.status_message = message
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.set_status('error', f'{type(exc).__name__}: {exc}')
+
+    def end(self) -> None:
+        self.end_ns = time.time_ns()
+        self._tracer._export(self)
+
+    # -- context manager --------------------------------------------------
+
+    def __enter__(self) -> 'Span':
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.record_exception(exc)
+        if self._token is not None:
+            _current_span.reset(self._token)
+        self.end()
+
+    def to_otlp(self) -> dict:
+        """OTLP/JSON span shape (subset)."""
+        return {
+            'traceId': self.trace_id,
+            'spanId': self.span_id,
+            'parentSpanId': self.parent_id,
+            'name': self.name,
+            'startTimeUnixNano': str(self.start_ns),
+            'endTimeUnixNano': str(self.end_ns),
+            'attributes': [
+                {'key': k, 'value': {'stringValue': str(v)}}
+                for k, v in self.attributes.items()],
+            'status': {'code': self.status, 'message': self.status_message},
+        }
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set_attribute(self, key, value):
+        pass
+
+    def set_status(self, status, message=''):
+        pass
+
+    def record_exception(self, exc):
+        pass
+
+    def end(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class InMemoryExporter:
+    """Bounded ring of finished spans (tests + /debug/traces)."""
+
+    def __init__(self, maxlen: int = 2048):
+        import collections
+        self._spans = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def __call__(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, name: str) -> List[Span]:
+        return [s for s in self.spans() if s.name == name]
+
+    def children_of(self, span: Span) -> List[Span]:
+        return [s for s in self.spans() if s.parent_id == span.span_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+class Tracer:
+    """reference: pkg/tracing — StartSpan/ChildSpan equivalents."""
+
+    def __init__(self, exporters: Optional[List[Callable[[Span], None]]]
+                 = None, enabled: bool = True):
+        self.exporters = exporters or []
+        self.enabled = enabled
+
+    def start_span(self, name: str,
+                   attributes: Optional[Dict[str, Any]] = None):
+        """Child of the context's current span (childspan.go ChildSpan1)."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        return Span(self, name, _current_span.get(), attributes)
+
+    def _export(self, span: Span) -> None:
+        for exporter in self.exporters:
+            try:
+                exporter(span)
+            except Exception:  # noqa: BLE001 - exporters must not break
+                pass
+
+
+_NOOP_TRACER = Tracer(enabled=False)
+_tracer: Tracer = _NOOP_TRACER
+_memory: Optional[InMemoryExporter] = None
+
+
+def configure(otlp_exporter: Optional[Callable[[Span], None]] = None,
+              memory: bool = True) -> Optional[InMemoryExporter]:
+    """Enable tracing (flag parity: cmd/internal/flag.go:46-49
+    enableTracing/tracingAddress). Returns the in-memory exporter."""
+    global _tracer, _memory
+    exporters: List[Callable[[Span], None]] = []
+    if memory:
+        _memory = InMemoryExporter()
+        exporters.append(_memory)
+    if otlp_exporter is not None:
+        exporters.append(otlp_exporter)
+    _tracer = Tracer(exporters)
+    return _memory
+
+
+def disable() -> None:
+    global _tracer, _memory
+    _tracer = _NOOP_TRACER
+    _memory = None
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def memory_exporter() -> Optional[InMemoryExporter]:
+    return _memory
+
+
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    return _tracer.start_span(name, attributes)
+
+
+def current_span():
+    return _current_span.get()

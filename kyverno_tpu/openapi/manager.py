@@ -96,11 +96,21 @@ class Manager:
 
     def __init__(self):
         self._schemas: Dict[str, Dict[str, str]] = dict(_BUILTIN_SCHEMAS)
+        # CRD-synced tier keyed by (group, kind): same-kind CRDs in
+        # different groups must not collide, and a re-synced CRD replaces
+        # its prior schema (no stale field types)
+        self._crd_schemas: Dict[tuple, Dict[str, str]] = {}
 
     def add_schema(self, kind: str, fields: Dict[str, str]) -> None:
-        """Extend/override the schema for a kind (the reference's CRD /
-        cluster-document sync feeds this, pkg/controllers/openapi)."""
+        """Extend/override the schema for a kind."""
         self._schemas.setdefault(kind, {}).update(fields)
+
+    def replace_crd_schemas(self,
+                            schemas: Dict[tuple, Dict[str, str]]) -> None:
+        """Swap in the freshly synced CRD schema set (the reference's
+        periodic sync semantics — deleted/retyped CRDs leave no residue;
+        pkg/controllers/openapi/controller.go:148)."""
+        self._crd_schemas = dict(schemas)
 
     def validate_resource(self, resource: dict,
                           kind: Optional[str] = None) -> None:
@@ -109,7 +119,19 @@ class Manager:
         if not isinstance(resource, dict):
             raise ValidationError('resource must be an object')
         kind = kind or resource.get('kind', '')
-        schema = self._schemas.get(kind)
+        api_version = resource.get('apiVersion', '') \
+            if isinstance(resource.get('apiVersion'), str) else ''
+        group = api_version.split('/')[0] if '/' in api_version else ''
+        schema = self._crd_schemas.get((group, kind))
+        if schema is None and group == '':
+            # resources often omit apiVersion in fixtures: a kind-unique
+            # CRD schema still applies
+            hits = [s for (g, k), s in self._crd_schemas.items()
+                    if k == kind]
+            if len(hits) == 1:
+                schema = hits[0]
+        if schema is None:
+            schema = self._schemas.get(kind)
         if schema is None:
             return  # unknown kinds are not schema-validated
         for path, expected in schema.items():

@@ -51,17 +51,19 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     def step(packed: Dict[str, jnp.ndarray]):
         t = unpack_batch(packed, evaluator.layout_holder['layout'])
         rowmask = t.pop('__rowmask__', None)
-        statuses, details, fdet = evaluator.raw(t)
+        # fdet is dropped here: the distributed summary path never
+        # synthesizes messages, and leaving it out of the jit outputs
+        # lets XLA DCE the whole fail-site computation
+        statuses, details, _fdet = evaluator.raw(t)
         # per-rule verdict histogram over the status codes; with GSPMD
         # the partial sums are psum-reduced over ICI automatically
         one_hot = jax.nn.one_hot(statuses, n_codes, dtype=jnp.int32)
         if rowmask is not None:
             one_hot = one_hot * rowmask[:, None, None]
         summary = jnp.sum(one_hot, axis=0)
-        return statuses, details, fdet, summary
+        return statuses, details, summary
 
     out_shardings = (NamedSharding(mesh, P(axis)),
-                     NamedSharding(mesh, P(axis)),
                      NamedSharding(mesh, P(axis)),
                      NamedSharding(mesh, P()))
     # input shardings propagate from the device_put placement in
@@ -122,5 +124,5 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     raw['__rowmask__'] = (np.arange(padded) < n).astype(np.int32)
     tensors, layout = shard_tensors(raw, mesh, axis)
     step = _cached_sharded_evaluator(cps, mesh, axis)
-    statuses, details, fdet, summary = step(tensors, layout)
+    statuses, details, summary = step(tensors, layout)
     return np.asarray(statuses)[:n], np.asarray(summary)

@@ -49,6 +49,14 @@ class MockRegistryClient:
         entry = self._entry(ref)
         entry['attestations'].append({'key': key_id, 'statement': statement})
 
+    def add_signature(self, ref: str, entry: dict) -> None:
+        """Attach a cryptographic signature entry (payload/signature[/cert])
+        as produced by cosign.signature_entry."""
+        self._entry(ref)['signatures'].append(entry)
+
+    def add_attestation(self, ref: str, entry: dict) -> None:
+        self._entry(ref)['attestations'].append(entry)
+
     # -- client interface ----------------------------------------------------
 
     def fetch_image_descriptor(self, ref: str) -> Descriptor:

@@ -110,13 +110,24 @@ class WebhookServer:
         return dict(self._routes)
 
     def handle(self, path: str, body: bytes) -> bytes:
-        """Dispatch one POST body through the route's handler chain."""
+        """Dispatch one POST body through the route's handler chain.
+
+        Each request runs under an HTTP-handler span (reference:
+        pkg/webhooks/handlers/trace.go:16 WithTrace); engine rule spans
+        nest under it via context propagation."""
         handler = self._routes.get(path)
         if handler is None:
             raise KeyError(path)
         review = json.loads(body)
         request = admission.parse_review(review)
-        resp = handler(request)
+        from ..observability import tracing
+        with tracing.start_span(
+                f'webhooks{path}',
+                {'uid': request.get('uid', ''),
+                 'kind': (request.get('kind') or {}).get('kind', ''),
+                 'operation': request.get('operation', '')}) as span:
+            resp = handler(request)
+            span.set_attribute('allowed', resp.get('allowed'))
         return json.dumps(
             admission.review_response(request, resp)).encode('utf-8')
 

@@ -1,0 +1,367 @@
+"""Real cosign signature cryptography (reference: pkg/cosign/cosign.go:63).
+
+Hermetic fixtures: keys and a self-signed CA generated in-test (like
+engine/k8smanifest's offline ECDSA verification). Every negative case is
+a *cryptographically* invalid input — tampered signature bytes, wrong
+key, wrong digest in the payload, identity mismatch, untrusted chain —
+not a metadata mismatch.
+"""
+
+import base64
+import datetime
+
+import pytest
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from kyverno_tpu.cosign import cosign
+from kyverno_tpu.registry.client import MockRegistryClient, RegistryError
+
+DIGEST = 'sha256:' + 'ab' * 32
+REF = 'ghcr.io/org/app:v1'
+
+
+def ec_key():
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def pem_public(key) -> str:
+    return key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+
+
+def pem_cert(cert) -> str:
+    return cert.public_bytes(serialization.Encoding.PEM).decode()
+
+
+def make_ca(cn='test-ca'):
+    key = ec_key()
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime(2026, 1, 1)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key()).serial_number(1)
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    return key, cert
+
+
+def make_leaf(ca_key, ca_cert, email='dev@example.com',
+              issuer_url='https://accounts.example.com'):
+    key = ec_key()
+    now = datetime.datetime(2026, 1, 1)
+    builder = (x509.CertificateBuilder()
+               .subject_name(x509.Name(
+                   [x509.NameAttribute(NameOID.COMMON_NAME, 'signer')]))
+               .issuer_name(ca_cert.subject)
+               .public_key(key.public_key()).serial_number(2)
+               .not_valid_before(now)
+               .not_valid_after(now + datetime.timedelta(days=365))
+               .add_extension(x509.SubjectAlternativeName(
+                   [x509.RFC822Name(email)]), critical=False))
+    if issuer_url:
+        builder = builder.add_extension(
+            x509.UnrecognizedExtension(
+                x509.ObjectIdentifier('1.3.6.1.4.1.57264.1.1'),
+                issuer_url.encode()), critical=False)
+    return key, builder.sign(ca_key, hashes.SHA256())
+
+
+def registry():
+    r = MockRegistryClient()
+    r.add_image(REF, DIGEST)
+    return r
+
+
+class TestKeyedVerification:
+    def test_valid_signature_passes(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        resp = cosign.verify_signature(
+            r, cosign.Options(REF, key=pem_public(key)))
+        assert resp.digest == DIGEST
+
+    def test_tampered_signature_fails(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(key, payload)
+        sig = bytearray(base64.b64decode(entry['signature']))
+        sig[-1] ^= 0xFF
+        entry['signature'] = base64.b64encode(bytes(sig)).decode()
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError, match='verification failed'):
+            cosign.verify_signature(
+                r, cosign.Options(REF, key=pem_public(key)))
+
+    def test_tampered_payload_fails(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(key, payload)
+        entry['payload'] = base64.b64encode(
+            cosign.make_payload(REF, 'sha256:' + 'cd' * 32)).decode()
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError, match='verification failed'):
+            cosign.verify_signature(
+                r, cosign.Options(REF, key=pem_public(key)))
+
+    def test_wrong_key_fails(self):
+        key, other = ec_key(), ec_key()
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            key, cosign.make_payload(REF, DIGEST)))
+        with pytest.raises(RegistryError):
+            cosign.verify_signature(
+                r, cosign.Options(REF, key=pem_public(other)))
+
+    def test_wrong_digest_in_payload_fails(self):
+        key = ec_key()
+        r = registry()
+        # correctly signed payload claiming a DIFFERENT image digest
+        payload = cosign.make_payload(REF, 'sha256:' + 'cd' * 32)
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        with pytest.raises(RegistryError, match='does not match image'):
+            cosign.verify_signature(
+                r, cosign.Options(REF, key=pem_public(key)))
+
+    def test_pem_attestor_rejects_legacy_metadata_entries(self):
+        key = ec_key()
+        r = registry()
+        r.sign(REF, 'legacy-id')  # metadata-only entry, no crypto
+        with pytest.raises(RegistryError):
+            cosign.verify_signature(
+                r, cosign.Options(REF, key=pem_public(key)))
+
+    def test_annotations_checked(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST, {'env': 'prod'})
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        assert cosign.verify_signature(r, cosign.Options(
+            REF, key=pem_public(key), annotations={'env': 'prod'})).digest
+        with pytest.raises(RegistryError, match='annotation'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), annotations={'env': 'dev'}))
+
+
+class TestKeylessVerification:
+    def test_chain_and_identity_pass(self):
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        r.add_signature(REF, cosign.signature_entry(
+            leaf_key, payload, cert_pem=pem_cert(leaf_cert)))
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, roots=pem_cert(ca_cert), subject='dev@example.com',
+            issuer='https://accounts.example.com'))
+        assert resp.digest == DIGEST
+
+    def test_subject_wildcard(self):
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            leaf_key, cosign.make_payload(REF, DIGEST),
+            cert_pem=pem_cert(leaf_cert)))
+        assert cosign.verify_signature(r, cosign.Options(
+            REF, roots=pem_cert(ca_cert),
+            subject='*@example.com')).digest == DIGEST
+
+    def test_identity_mismatch_fails(self):
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            leaf_key, cosign.make_payload(REF, DIGEST),
+            cert_pem=pem_cert(leaf_cert)))
+        with pytest.raises(RegistryError, match='subject'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, roots=pem_cert(ca_cert),
+                subject='other@example.com'))
+        with pytest.raises(RegistryError, match='issuer'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, roots=pem_cert(ca_cert),
+                issuer='https://evil.example.com'))
+
+    def test_untrusted_ca_fails(self):
+        ca_key, ca_cert = make_ca()
+        other_ca_key, other_ca_cert = make_ca('other-ca')
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            leaf_key, cosign.make_payload(REF, DIGEST),
+            cert_pem=pem_cert(leaf_cert)))
+        with pytest.raises(RegistryError, match='chain'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, roots=pem_cert(other_ca_cert)))
+
+    def test_intermediate_chain(self):
+        root_key, root_cert = make_ca('root')
+        int_key = ec_key()
+        now = datetime.datetime(2026, 1, 1)
+        int_name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, 'intermediate')])
+        int_cert = (x509.CertificateBuilder()
+                    .subject_name(int_name).issuer_name(root_cert.subject)
+                    .public_key(int_key.public_key()).serial_number(3)
+                    .not_valid_before(now)
+                    .not_valid_after(now + datetime.timedelta(days=730))
+                    .add_extension(
+                        x509.BasicConstraints(ca=True, path_length=0),
+                        critical=True)
+                    .sign(root_key, hashes.SHA256()))
+        leaf_key, leaf_cert = make_leaf(int_key, int_cert)
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            leaf_key, cosign.make_payload(REF, DIGEST),
+            cert_pem=pem_cert(leaf_cert), chain_pem=pem_cert(int_cert)))
+        assert cosign.verify_signature(r, cosign.Options(
+            REF, roots=pem_cert(root_cert))).digest == DIGEST
+
+
+class TestPinnedCert:
+    def test_pinned_cert_ignores_entry_cert(self):
+        """With a pinned attestor cert, an attacker-supplied entry cert
+        must never be the verification key."""
+        ca_key, ca_cert = make_ca()
+        pinned_key, pinned_cert = make_leaf(ca_key, ca_cert)
+        evil_key, evil_cert = make_leaf(*make_ca('evil'),
+                                        email='dev@example.com')
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        # entry signed by the ATTACKER's key, carrying the attacker cert
+        r.add_signature(REF, cosign.signature_entry(
+            evil_key, payload, cert_pem=pem_cert(evil_cert)))
+        with pytest.raises(RegistryError, match='verification failed'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, cert=pem_cert(pinned_cert)))
+        # the genuine pinned-key signature passes
+        r.add_signature(REF, cosign.signature_entry(pinned_key, payload))
+        assert cosign.verify_signature(r, cosign.Options(
+            REF, cert=pem_cert(pinned_cert))).digest == DIGEST
+
+    def test_keyless_without_roots_rejected(self):
+        key, cert = make_leaf(*make_ca())
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            key, cosign.make_payload(REF, DIGEST),
+            cert_pem=pem_cert(cert)))
+        with pytest.raises(RegistryError, match='requires roots'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, subject='dev@example.com'))
+
+    def test_attestation_keyless_without_roots_dropped(self):
+        import json as _json
+        key, cert = make_leaf(*make_ca())
+        payload = _json.dumps({'predicateType': 'x'}).encode()
+        r = registry()
+        r.add_attestation(REF, {
+            'payload': base64.b64encode(payload).decode(),
+            'signature': base64.b64encode(
+                cosign.sign_payload(key, payload)).decode(),
+            'cert': pem_cert(cert)})
+        resp = cosign.fetch_attestations(
+            r, cosign.Options(REF, subject='dev@example.com'))
+        assert resp.statements == []
+
+    def test_malformed_entry_cert_skips_to_valid_entry(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        bad = cosign.signature_entry(key, payload)
+        bad['cert'] = ('-----BEGIN CERTIFICATE-----\ngarbage\n'
+                       '-----END CERTIFICATE-----\n')
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        good = cosign.signature_entry(leaf_key, payload,
+                                      cert_pem=pem_cert(leaf_cert))
+        r.add_signature(REF, bad)
+        r.add_signature(REF, good)
+        assert cosign.verify_signature(r, cosign.Options(
+            REF, roots=pem_cert(ca_cert))).digest == DIGEST
+
+
+class TestEngineIntegration:
+    """verifyImages rules with PEM-keyed attestors run real crypto
+    (reference: pkg/engine/imageVerify.go:69 VerifyAndPatchImages)."""
+
+    def _policy(self, key_pem):
+        from kyverno_tpu.api.policy import Policy
+        return Policy({
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 'verify', 'annotations': {
+                'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+            'spec': {'rules': [{
+                'name': 'check-sig',
+                'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+                'verifyImages': [{
+                    'imageReferences': ['ghcr.io/org/*'],
+                    'attestors': [{'entries': [
+                        {'keys': {'publicKeys': key_pem}}]}],
+                    'mutateDigest': True,
+                }]}]}})
+
+    def _pod(self):
+        return {'apiVersion': 'v1', 'kind': 'Pod',
+                'metadata': {'name': 'p', 'namespace': 'd'},
+                'spec': {'containers': [{'name': 'c', 'image': REF}]}}
+
+    def test_real_key_pass_and_fail(self):
+        from kyverno_tpu.engine.api import PolicyContext, RuleStatus
+        from kyverno_tpu.engine.engine import Engine
+        key = ec_key()
+        r = registry()
+        r.add_signature(REF, cosign.signature_entry(
+            key, cosign.make_payload(REF, DIGEST)))
+        engine = Engine()
+        pctx = PolicyContext(self._policy(pem_public(key)),
+                             new_resource=self._pod())
+        er, _ = engine.verify_and_patch_images(pctx, r)
+        assert er.policy_response.rules[0].status == RuleStatus.PASS
+        # unsigned image with a different (real) key must fail
+        pctx2 = PolicyContext(self._policy(pem_public(ec_key())),
+                              new_resource=self._pod())
+        er2, _ = engine.verify_and_patch_images(pctx2, r)
+        assert er2.policy_response.rules[0].status == RuleStatus.FAIL
+
+
+class TestAttestationCrypto:
+    def test_signed_statement_verifies(self):
+        key = ec_key()
+        r = registry()
+        import json
+        stmt = {'_type': 'https://in-toto.io/Statement/v0.1',
+                'predicateType': 'https://slsa.dev/provenance/v0.2',
+                'predicate': {'builder': {'id': 'gh-actions'}}}
+        payload = json.dumps(stmt).encode()
+        r.add_attestation(REF, {
+            'payload': base64.b64encode(payload).decode(),
+            'signature': base64.b64encode(
+                cosign.sign_payload(key, payload)).decode()})
+        resp = cosign.fetch_attestations(
+            r, cosign.Options(REF, key=pem_public(key)))
+        assert resp.statements == [stmt]
+
+    def test_bad_attestation_signature_dropped(self):
+        key, other = ec_key(), ec_key()
+        r = registry()
+        import json
+        payload = json.dumps({'predicateType': 'x'}).encode()
+        r.add_attestation(REF, {
+            'payload': base64.b64encode(payload).decode(),
+            'signature': base64.b64encode(
+                cosign.sign_payload(other, payload)).decode()})
+        resp = cosign.fetch_attestations(
+            r, cosign.Options(REF, key=pem_public(key)))
+        assert resp.statements == []

@@ -108,3 +108,95 @@ spec:
         resp = json.loads(body)['response']
         assert resp['allowed'] is False
         assert 'schema validation' in resp['status']['message']
+
+
+class TestCRDSchemaSync:
+    """CRD openAPIV3Schema ingestion (reference:
+    pkg/controllers/openapi/controller.go:148)."""
+
+    WIDGET_SCHEMA = {
+        'type': 'object',
+        'properties': {
+            'spec': {
+                'type': 'object',
+                'properties': {
+                    'size': {'type': 'integer'},
+                    'name': {'type': 'string'},
+                    'tags': {'type': 'array',
+                             'items': {'type': 'string'}},
+                    'labels': {'type': 'object',
+                               'additionalProperties': {'type': 'string'}},
+                    'nested': {'type': 'object', 'properties': {
+                        'enabled': {'type': 'boolean'}}},
+                },
+            },
+        },
+    }
+
+    def _client_with_crd(self):
+        from kyverno_tpu.controllers.openapi import crd_fixture
+        from kyverno_tpu.dclient.client import FakeClient
+        client = FakeClient()
+        client.create_resource(
+            'apiextensions.k8s.io/v1', 'CustomResourceDefinition', '',
+            crd_fixture('example.io', 'Widget', 'widgets',
+                        self.WIDGET_SCHEMA))
+        return client
+
+    def test_schema_flattening(self):
+        from kyverno_tpu.controllers.openapi import schema_to_fields
+        fields = schema_to_fields(self.WIDGET_SCHEMA)
+        assert fields['spec.size'] == 'integer'
+        assert fields['spec.tags'] == 'array'
+        assert fields['spec.labels'] == 'string-map'
+        assert fields['spec.nested.enabled'] == 'boolean'
+
+    def test_sync_then_validate(self):
+        from kyverno_tpu.controllers.openapi import OpenAPIController
+        manager = Manager()
+        ctrl = OpenAPIController(self._client_with_crd(), manager)
+        assert ctrl.reconcile() == 1
+        manager.validate_resource({'kind': 'Widget',
+                                   'spec': {'size': 3, 'name': 'w'}})
+        with pytest.raises(ValidationError, match='size'):
+            manager.validate_resource({'kind': 'Widget',
+                                       'spec': {'size': 'big'}})
+
+    def test_mutated_crd_instance_type_violation_rejected(self):
+        """A mutation that breaks a CRD field type is denied at the
+        webhook once the CRD schema is synced."""
+        from kyverno_tpu.controllers.openapi import OpenAPIController
+        from kyverno_tpu.policycache.cache import Cache
+        from kyverno_tpu.webhooks.handlers import ResourceHandlers
+        from kyverno_tpu.webhooks.server import WebhookServer
+        policy = Policy({
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 'bad-mutator', 'annotations': {
+                'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+            'spec': {'rules': [{
+                'name': 'break-size',
+                'match': {'any': [{'resources': {'kinds': ['Widget']}}]},
+                'mutate': {'patchStrategicMerge': {
+                    'spec': {'size': 'enormous'}}}}]}})
+        cache = Cache()
+        cache.warm_up([policy])
+        handlers = ResourceHandlers(cache)
+        ctrl = OpenAPIController(self._client_with_crd(),
+                                 handlers.openapi_manager)
+        assert ctrl.reconcile() == 1
+        server = WebhookServer(handlers)
+        body = server.handle('/mutate/fail', json.dumps({
+            'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+            'request': {
+                'uid': 'u1', 'operation': 'CREATE',
+                'kind': {'group': 'example.io', 'version': 'v1',
+                         'kind': 'Widget'},
+                'namespace': 'default', 'name': 'w',
+                'object': {'apiVersion': 'example.io/v1', 'kind': 'Widget',
+                           'metadata': {'name': 'w',
+                                        'namespace': 'default'},
+                           'spec': {'size': 1}},
+                'userInfo': {'username': 'tester'}}}).encode())
+        resp = json.loads(body)['response']
+        assert resp['allowed'] is False
+        assert 'schema validation' in resp['status']['message']

@@ -1,0 +1,145 @@
+"""Tracing + profiling subsystem (reference: pkg/tracing/childspan.go,
+pkg/webhooks/handlers/trace.go:16, pkg/profiling/pprof.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.observability import tracing
+from kyverno_tpu.observability.profiling import ProfilingServer
+from kyverno_tpu.policycache.cache import Cache
+from kyverno_tpu.webhooks.handlers import ResourceHandlers
+from kyverno_tpu.webhooks.server import WebhookServer
+
+POLICY = {
+    'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+    'metadata': {'name': 'require-labels', 'annotations': {
+        'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+    'spec': {'validationFailureAction': 'Enforce', 'rules': [
+        {'name': 'check-app',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'validate': {'message': 'app label required',
+                      'pattern': {'metadata': {'labels': {'app': '?*'}}}}},
+        {'name': 'check-team',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'validate': {'message': 'team label required',
+                      'pattern': {'metadata': {'labels': {'team': '?*'}}}}},
+    ]}}
+
+
+def review(doc):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {'uid': 'u1', 'operation': 'CREATE',
+                    'kind': {'group': '', 'version': 'v1', 'kind': 'Pod'},
+                    'namespace': 'default', 'name': 'p',
+                    'object': doc,
+                    'userInfo': {'username': 'tester'}}}).encode()
+
+
+def pod():
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': 'p', 'namespace': 'default',
+                         'labels': {'app': 'x'}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx:1'}]}}
+
+
+@pytest.fixture
+def mem():
+    exporter = tracing.configure()
+    yield exporter
+    tracing.disable()
+
+
+class TestSpans:
+    def test_admission_request_span_hierarchy(self, mem):
+        cache = Cache()
+        cache.warm_up([Policy(POLICY)])
+        server = WebhookServer(ResourceHandlers(cache, device=False))
+        server.handle('/validate/fail', review(pod()))
+
+        [root] = mem.find('webhooks/validate/fail')
+        assert root.parent_id == ''
+        assert root.attributes['operation'] == 'CREATE'
+        # the pod carries 'app' but not 'team' → enforce denies
+        assert root.attributes['allowed'] is False
+        rule_spans = mem.find('kyverno/engine/rule')
+        assert len(rule_spans) == 2
+        for span in rule_spans:
+            # rule spans nest under the handler span, same trace
+            assert span.parent_id == root.span_id
+            assert span.trace_id == root.trace_id
+            assert span.attributes['policy'] == 'require-labels'
+        assert {s.attributes['rule'] for s in rule_spans} == \
+            {'check-app', 'check-team'}
+        assert {s.attributes['status'] for s in rule_spans} == \
+            {'pass', 'fail'}
+
+    def test_device_scan_span_nests(self, mem):
+        cache = Cache()
+        cache.warm_up([Policy(POLICY)])
+        server = WebhookServer(ResourceHandlers(cache, device=True))
+        server.handle('/validate/fail', review(pod()))
+        [root] = mem.find('webhooks/validate/fail')
+        scans = mem.find('kyverno/device/scan')
+        assert scans and scans[0].parent_id == root.span_id
+
+    def test_exception_recorded(self, mem):
+        with pytest.raises(ValueError):
+            with tracing.start_span('boom'):
+                raise ValueError('nope')
+        [span] = mem.find('boom')
+        assert span.status == 'error' and 'nope' in span.status_message
+
+    def test_noop_without_configure(self):
+        tracing.disable()
+        with tracing.start_span('x') as s:
+            s.set_attribute('a', 1)
+        assert tracing.memory_exporter() is None
+
+    def test_otlp_shape(self, mem):
+        with tracing.start_span('shape', {'k': 'v'}):
+            pass
+        [span] = mem.find('shape')
+        otlp = span.to_otlp()
+        assert otlp['name'] == 'shape'
+        assert otlp['attributes'] == [
+            {'key': 'k', 'value': {'stringValue': 'v'}}]
+        assert int(otlp['endTimeUnixNano']) >= int(
+            otlp['startTimeUnixNano'])
+
+
+class TestProfiling:
+    def test_endpoints(self, mem):
+        srv = ProfilingServer(port=0)
+        port = srv.start()
+        try:
+            with tracing.start_span('profiled-op'):
+                pass
+            base = f'http://127.0.0.1:{port}'
+            stacks = urllib.request.urlopen(
+                f'{base}/debug/pprof/goroutine').read().decode()
+            assert 'thread' in stacks
+            prof = urllib.request.urlopen(
+                f'{base}/debug/pprof/profile?seconds=0.2').read().decode()
+            assert prof  # folded stacks or (idle)
+            traces = json.loads(urllib.request.urlopen(
+                f'{base}/debug/traces').read())
+            assert any(s['name'] == 'profiled-op'
+                       for s in traces['spans'])
+        finally:
+            srv.stop()
+
+    def test_setup_flags(self):
+        from kyverno_tpu.cmd.internal import Setup
+        s = Setup('kyverno', args=['--enable-tracing', '--profile',
+                                   '--profile-port', '0'])
+        try:
+            assert s.profiling_server is not None
+            assert tracing.memory_exporter() is not None
+        finally:
+            if s.profiling_server:
+                s.profiling_server.stop()
+            tracing.disable()
