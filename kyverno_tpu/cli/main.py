@@ -68,6 +68,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_fn = jp_sub.add_parser('function', help='list custom functions')
     p_fn.add_argument('name', nargs='*')
 
+    p_oci = sub.add_parser(
+        'oci', help='push/pull policies as OCI artifacts')
+    oci_sub = p_oci.add_subparsers(dest='oci_command')
+    p_push = oci_sub.add_parser('push', help='bundle policies to a ref')
+    p_push.add_argument('paths', nargs='+',
+                        help='policy file(s) or dir(s)')
+    p_push.add_argument('--image', '-i', dest='ref', required=True,
+                        help='layout-dir:tag destination ref')
+    p_pull = oci_sub.add_parser('pull', help='extract policies from a ref')
+    p_pull.add_argument('--image', '-i', dest='ref', required=True,
+                        help='layout-dir:tag source ref')
+    p_pull.add_argument('--output', '-o', help='output directory')
+
     sub.add_parser('version', help='print version')
     return parser
 
@@ -90,6 +103,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.jp_command == 'function':
             return jp_command.command_function(args)
         print('usage: kyverno jp {query,parse,function}')
+        return 1
+    if args.command == 'oci':
+        from . import oci_command
+        if args.oci_command == 'push':
+            return oci_command.command_push(args)
+        if args.oci_command == 'pull':
+            return oci_command.command_pull(args)
+        print('usage: kyverno oci {push,pull}')
         return 1
     if args.command == 'version':
         print(f'Version: {__version__}')

@@ -45,6 +45,11 @@ class AdmissionController:
         self.openapi_controller = OpenAPIController(
             setup.client, self.handlers.openapi_manager)
         self.openapi_controller.reconcile()
+        # policy change/rule-info metrics driven by policy events
+        # (reference: pkg/controllers/metrics/policy/controller.go:155)
+        from ..controllers.policymetrics import PolicyMetricsController
+        self.policy_metrics = PolicyMetricsController(
+            setup.client, setup.metrics)
         self.server = WebhookServer(
             self.handlers, configuration=setup.configuration,
             port=port, certfile=certfile, keyfile=keyfile)

@@ -1,35 +1,107 @@
 """Cleanup-controller daemon (reference: cmd/cleanup-controller/main.go):
-evaluates CleanupPolicy schedules and deletes matching resources."""
+reconciles a CronJob CR per CleanupPolicy and serves the ``/cleanup``
+HTTP endpoint the CronJobs call back (reference:
+cmd/cleanup-controller/handlers/cleanup/handlers.go); the in-process
+cron tick additionally runs due policies directly so deletions happen
+even without an external job runner."""
 
 from __future__ import annotations
 
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
 
 from ..controllers.cleanup import CleanupController
 from ..controllers.leaderelection import mesh_is_leader
 from .internal import Setup, base_parser
 
 
+class CleanupHTTPServer:
+    """Serves GET /cleanup?policy=<ns/name>
+    (reference: cmd/cleanup-controller/handlers/cleanup)."""
+
+    def __init__(self, controller: CleanupController, port: int = 0):
+        self.controller = controller
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        controller = self.controller
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 - quiet
+                pass
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                if parsed.path != CleanupController.CLEANUP_SERVICE_PATH:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                policy = parse_qs(parsed.query).get('policy', [''])[0]
+                try:
+                    deleted = controller.handle_cleanup_request(policy)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = f'cleaned {len(deleted)} resources\n'.encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(('127.0.0.1', self.port),
+                                          _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name='ktpu-cleanup', daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
 class CleanupDaemon:
-    def __init__(self, setup: Setup):
+    def __init__(self, setup: Setup, http_port: int = 0):
         self.setup = setup
         self.controller = CleanupController(setup.client)
+        self.server = CleanupHTTPServer(self.controller, http_port)
 
-    def tick(self) -> None:
-        if not mesh_is_leader():
-            return
+    def sync_policies(self) -> None:
+        seen = set()
+        all_listed = True
         for kind in ('ClusterCleanupPolicy', 'CleanupPolicy'):
             try:
                 for doc in self.setup.client.list_resource(
                         'kyverno.io/v2alpha1', kind, '', None):
                     self.controller.set_policy(doc)
+                    seen.add(CleanupController._key(doc))
             except Exception:  # noqa: BLE001
-                continue
+                # a transient list failure must NOT cascade into pruning
+                # (and hence CronJob deletion) of this kind's policies
+                all_listed = False
+        if all_listed:
+            self.controller.retain_policies(seen)
+
+    def tick(self) -> None:
+        if not mesh_is_leader():
+            return
+        self.sync_policies()
+        self.controller.reconcile_cronjobs(self.setup.options.namespace)
         self.controller.tick()
 
     def run(self) -> None:
+        self.server.start()
         self.setup.install_signal_handlers()
         self.setup.run_until_stopped(self.tick, interval=10.0)
+        self.server.stop()
 
 
 def main(args: Optional[List[str]] = None) -> int:

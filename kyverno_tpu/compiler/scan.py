@@ -105,18 +105,29 @@ class _EncoderPool:
             self._pool = None
 
 
-def _rule_match_is_simple(rule: dict) -> bool:
+_LABEL_MATCH_KEYS = _SIMPLE_MATCH_KEYS | {'selector'}
+
+
+def _rule_match_is_simple(rule: dict, keys=_SIMPLE_MATCH_KEYS) -> bool:
     """True when match/exclude depend only on kind/apiVersion/namespace."""
     def block_simple(block: dict) -> bool:
         for f in [block] + (block.get('any') or []) + (block.get('all') or []):
             res = f.get('resources') or {}
-            if any(k not in _SIMPLE_MATCH_KEYS for k in res):
+            if any(k not in keys for k in res):
                 return False
             if f.get('roles') or f.get('clusterRoles') or f.get('subjects'):
                 return False
         return True
     return block_simple(rule.get('match') or {}) and \
         block_simple(rule.get('exclude') or {})
+
+
+def _rule_match_is_label_simple(rule: dict) -> bool:
+    """True when match/exclude additionally reference only the resource's
+    label selector — the decision is a function of (group key, labels),
+    so selector-heavy policies cache per distinct label set instead of
+    per resource (the adversarial regime for the group cache)."""
+    return _rule_match_is_simple(rule, _LABEL_MATCH_KEYS)
 
 
 def _group_key(doc: dict) -> Tuple[str, str, str]:
@@ -152,9 +163,19 @@ class BatchScanner:
             if prog.policy_index not in host_set]
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
+        from collections import OrderedDict
         self._simple_match = [
             _rule_match_is_simple(p.rule_raw or {}) for p in self.cps.programs]
-        self._match_cache: Dict[Tuple, np.ndarray] = {}
+        self._label_match = [
+            not s and _rule_match_is_label_simple(p.rule_raw or {})
+            for s, p in zip(self._simple_match, self.cps.programs)]
+        # LRU-bounded: one row per (kind, apiVersion, namespace, operation)
+        # group — long-lived admission scanners in many-namespace clusters
+        # must not grow without bound.  Locked: webhook threads share one
+        # scanner and race get/evict/move_to_end otherwise.
+        self._match_cache: 'OrderedDict[Tuple, np.ndarray]' = OrderedDict()
+        self._match_cache_max = 4096
+        self._match_cache_lock = __import__('threading').Lock()
         self._rules = [Rule(p.rule_raw or {}) for p in self.cps.programs]
         self._fail_msg_cache: Dict[Tuple, Optional[str]] = {}
         self._encoder_pool = _EncoderPool(
@@ -204,17 +225,48 @@ class BatchScanner:
         groups: Dict[Tuple, List[int]] = {}
         for i, doc in enumerate(resources):
             groups.setdefault(_group_key(doc) + (operation,), []).append(i)
+        def cache_get(key):
+            with self._match_cache_lock:
+                hit = self._match_cache.get(key)
+                if hit is not None:
+                    self._match_cache.move_to_end(key)
+                return hit
+
+        def cache_put(key, value):
+            with self._match_cache_lock:
+                while len(self._match_cache) >= self._match_cache_max:
+                    self._match_cache.popitem(last=False)
+                self._match_cache[key] = value
+
         for key, idxs in groups.items():
-            cached = self._match_cache.get(key)
+            cached = cache_get(key)
             if cached is None:
                 rep = wrapped[idxs[0]]
                 cached = np.array([
                     self._match_one(j, rep, adm3) if simple[j] else False
                     for j in range(p)])
-                self._match_cache[key] = cached
+                cache_put(key, cached)
             match[idxs, :] = cached
-        # non-simple rules: evaluate per resource
-        for j in np.nonzero(~simple)[0]:
+        # label-selector rules: the decision depends only on (group,
+        # labels) — cache per distinct label set (cardinality of label
+        # combinations, not of resources)
+        label_js = np.nonzero(np.asarray(self._label_match))[0]
+        if label_js.size:
+            for i, doc in enumerate(resources):
+                labels = (doc.get('metadata') or {}).get('labels') or {}
+                lkey = (_group_key(doc), operation,
+                        tuple(sorted(labels.items())))
+                cached = cache_get(lkey)
+                if cached is None:
+                    cached = np.array([
+                        self._match_one(int(j), wrapped[i], adm3)
+                        for j in label_js])
+                    cache_put(lkey, cached)
+                match[i, label_js] = cached
+        # remaining non-simple rules (names, annotations, wildcard
+        # namespaces, roles): evaluate per resource
+        rest = ~simple & ~np.asarray(self._label_match)
+        for j in np.nonzero(rest)[0]:
             for i in range(n):
                 match[i, j] = self._match_one(int(j), wrapped[i], adm3)
         return match

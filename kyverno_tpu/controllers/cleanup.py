@@ -77,6 +77,14 @@ class CleanupController:
         with self._lock:
             self._policies.pop(self._key(doc), None)
 
+    def retain_policies(self, keys) -> None:
+        """Drop tracked policies not in ``keys`` (cluster-sync prune)."""
+        keys = set(keys)
+        with self._lock:
+            for key in list(self._policies):
+                if key not in keys:
+                    del self._policies[key]
+
     @staticmethod
     def _key(doc: dict) -> str:
         meta = doc.get('metadata') or {}
@@ -106,6 +114,101 @@ class CleanupController:
             self._last_run[key] = minute
             deleted.extend(self.cleanup(doc))
         return deleted
+
+    CLEANUP_SERVICE_PATH = '/cleanup'  # reference: controller.go:28
+
+    def reconcile_cronjobs(self, namespace: str = 'kyverno',
+                           service: str = 'https://cleanup-controller.'
+                                          'kyverno.svc') -> List[dict]:
+        """Materialize one CronJob CR per cleanup policy whose schedule
+        calls back the ``/cleanup`` endpoint — the reference's externally
+        visible deployment contract (reference:
+        pkg/controllers/cleanup/controller.go:164 buildCronJob).  Stale
+        CronJobs of deleted policies are removed.  Returns the CronJobs.
+        """
+        with self._lock:
+            policies = dict(self._policies)
+        desired = {}
+        for key, doc in policies.items():
+            meta = doc.get('metadata') or {}
+            pol_ns = meta.get('namespace', '')
+            kind = 'CleanupPolicy' if pol_ns else 'ClusterCleanupPolicy'
+            # the policy namespace is part of the name: same-named
+            # policies in different namespaces must not collide
+            name = f"cleanup-{pol_ns}-{meta.get('name', '')}" if pol_ns \
+                else f"cleanup-{meta.get('name', '')}"
+            cronjob = {
+                'apiVersion': 'batch/v1', 'kind': 'CronJob',
+                'metadata': {
+                    'name': name, 'namespace': namespace,
+                    'ownerReferences': [{
+                        'apiVersion': 'kyverno.io/v2alpha1',
+                        'kind': kind, 'name': meta.get('name', ''),
+                        'uid': meta.get('uid', ''),
+                    }],
+                },
+                'spec': {
+                    'schedule': (doc.get('spec') or {}).get('schedule', ''),
+                    'successfulJobsHistoryLimit': 0,
+                    'failedJobsHistoryLimit': 1,
+                    'concurrencyPolicy': 'Forbid',
+                    'jobTemplate': {'spec': {'template': {'spec': {
+                        'restartPolicy': 'OnFailure',
+                        'containers': [{
+                            'name': 'cleanup',
+                            'image': 'curlimages/curl:7.86.0',
+                            'args': [
+                                '-k',
+                                f'{service}'
+                                f'{self.CLEANUP_SERVICE_PATH}'
+                                f'?policy={key}'],
+                            'securityContext': {
+                                'allowPrivilegeEscalation': False,
+                                'runAsNonRoot': True,
+                                'runAsUser': 1000,
+                                'seccompProfile': {'type': 'RuntimeDefault'},
+                                'capabilities': {'drop': ['ALL']},
+                            },
+                        }],
+                    }}}},
+                },
+            }
+            desired[name] = cronjob
+        out = []
+        for name, cronjob in desired.items():
+            try:
+                existing = self.client.get_resource(
+                    'batch/v1', 'CronJob', namespace, name)
+            except Exception:  # noqa: BLE001
+                existing = None
+            if existing is None:
+                out.append(self.client.create_resource(
+                    'batch/v1', 'CronJob', namespace, cronjob))
+            else:
+                existing['spec'] = cronjob['spec']
+                existing['metadata']['ownerReferences'] = \
+                    cronjob['metadata']['ownerReferences']
+                out.append(self.client.update_resource(
+                    'batch/v1', 'CronJob', namespace, existing))
+        try:
+            for cj in self.client.list_resource('batch/v1', 'CronJob',
+                                                namespace, None):
+                name = (cj.get('metadata') or {}).get('name', '')
+                if name.startswith('cleanup-') and name not in desired:
+                    self.client.delete_resource('batch/v1', 'CronJob',
+                                                namespace, name)
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def handle_cleanup_request(self, policy_key: str) -> List[dict]:
+        """The ``/cleanup?policy=ns/name`` endpoint body (reference:
+        cmd/cleanup-controller/handlers/cleanup/handlers.go)."""
+        with self._lock:
+            doc = self._policies.get(policy_key)
+        if doc is None:
+            raise KeyError(policy_key)
+        return self.cleanup(doc)
 
     def cleanup(self, doc: dict) -> List[dict]:
         """One deletion pass for a cleanup policy

@@ -24,6 +24,36 @@ RENEW_DEADLINE = 10.0
 RETRY_PERIOD = 2.0
 
 
+def _to_microtime(ts: float) -> str:
+    """coordination.k8s.io/v1 Lease renewTime is RFC3339 MicroTime —
+    client-go holders cannot parse an epoch float."""
+    import datetime
+    dt = datetime.datetime.fromtimestamp(ts, tz=datetime.timezone.utc)
+    return dt.strftime('%Y-%m-%dT%H:%M:%S.%f') + 'Z'
+
+
+def _parse_microtime(value) -> float:
+    """Accept both RFC3339 MicroTime and the legacy epoch-float form."""
+    if value is None or value == '':
+        return 0.0
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    import datetime
+    try:
+        dt = datetime.datetime.strptime(str(value),
+                                        '%Y-%m-%dT%H:%M:%S.%fZ')
+        return dt.replace(tzinfo=datetime.timezone.utc).timestamp()
+    except ValueError:
+        try:
+            dt = datetime.datetime.strptime(str(value),
+                                            '%Y-%m-%dT%H:%M:%SZ')
+            return dt.replace(tzinfo=datetime.timezone.utc).timestamp()
+        except ValueError:
+            return 0.0
+
+
 def mesh_is_leader() -> bool:
     """Process 0 of the jax.distributed group leads (single-process
     setups are trivially the leader)."""
@@ -55,35 +85,55 @@ class LeaderElector:
         return self._leading
 
     def try_acquire(self, now: Optional[float] = None) -> bool:
-        """One acquire/renew attempt; returns leadership state."""
+        """One acquire/renew attempt; returns leadership state.
+
+        The claim is a compare-and-swap: the update carries the observed
+        ``resourceVersion``, so two replicas racing on an expired lease
+        cannot both win — the loser's update conflicts (409) and it
+        re-reads before deciding (client-go LeaderElector semantics)."""
         now = now or time.time()
-        lease = None
-        try:
-            lease = self.client.get_resource(
-                'coordination.k8s.io/v1', 'Lease', self.namespace,
-                self.name)
-        except Exception:  # noqa: BLE001
+        for _attempt in range(3):
             lease = None
-        if lease is None:
-            self.client.create_resource(
-                'coordination.k8s.io/v1', 'Lease', self.namespace, {
-                    'apiVersion': 'coordination.k8s.io/v1', 'kind': 'Lease',
-                    'metadata': {'name': self.name,
-                                 'namespace': self.namespace},
-                    'spec': {'holderIdentity': self.identity,
-                             'renewTime': now,
-                             'leaseDurationSeconds': int(LEASE_DURATION)}})
-            self._set_leading(True)
-            return True
-        spec = lease.setdefault('spec', {})
-        holder = spec.get('holderIdentity', '')
-        renew = float(spec.get('renewTime') or 0)
-        expired = now - renew > LEASE_DURATION
-        if holder == self.identity or expired or not holder:
+            try:
+                lease = self.client.get_resource(
+                    'coordination.k8s.io/v1', 'Lease', self.namespace,
+                    self.name)
+            except Exception:  # noqa: BLE001
+                lease = None
+            if lease is None:
+                try:
+                    self.client.create_resource(
+                        'coordination.k8s.io/v1', 'Lease', self.namespace, {
+                            'apiVersion': 'coordination.k8s.io/v1',
+                            'kind': 'Lease',
+                            'metadata': {'name': self.name,
+                                         'namespace': self.namespace},
+                            'spec': {
+                                'holderIdentity': self.identity,
+                                'renewTime': _to_microtime(now),
+                                'leaseDurationSeconds':
+                                    int(LEASE_DURATION)}})
+                except Exception:  # noqa: BLE001 - lost the create race
+                    continue
+                self._set_leading(True)
+                return True
+            spec = lease.setdefault('spec', {})
+            holder = spec.get('holderIdentity', '')
+            renew = _parse_microtime(spec.get('renewTime'))
+            expired = now - renew > LEASE_DURATION
+            if not (holder == self.identity or expired or not holder):
+                self._set_leading(False)
+                return False
             spec['holderIdentity'] = self.identity
-            spec['renewTime'] = now
-            self.client.update_resource(
-                'coordination.k8s.io/v1', 'Lease', self.namespace, lease)
+            spec['renewTime'] = _to_microtime(now)
+            try:
+                # the lease still carries the resourceVersion we read —
+                # a concurrent claimant makes this raise, and we re-read
+                self.client.update_resource(
+                    'coordination.k8s.io/v1', 'Lease', self.namespace,
+                    lease)
+            except Exception:  # noqa: BLE001 - conflict: re-observe
+                continue
             self._set_leading(True)
             return True
         self._set_leading(False)

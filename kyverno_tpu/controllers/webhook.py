@@ -52,9 +52,14 @@ _KIND_RESOURCES = {
 
 def _policy_kinds(policies: List[Policy], want) -> Dict[str, Set[str]]:
     """kinds with their failure actions for the selected rule types."""
+    from ..config.toggle import FORCE_FAILURE_POLICY_IGNORE
+    force_ignore = FORCE_FAILURE_POLICY_IGNORE.enabled()
     kinds: Dict[str, Set[str]] = {}
     for policy in policies:
-        fail_policy = (policy.spec.get('failurePolicy') or 'Fail')
+        # env-tier toggle (reference: pkg/toggle/toggle.go:23
+        # ForceFailurePolicyIgnore)
+        fail_policy = 'Ignore' if force_ignore else \
+            (policy.spec.get('failurePolicy') or 'Fail')
         for rule in policy.rules:
             if not want(rule):
                 continue

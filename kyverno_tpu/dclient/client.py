@@ -27,6 +27,11 @@ class NotFoundError(ApiError):
     reason = 'NotFound'
 
 
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure: stale resourceVersion
+    (HTTP 409 from a real API server)."""
+
+
 class AlreadyExistsError(ApiError):
     reason = 'AlreadyExists'
 
@@ -129,6 +134,16 @@ class FakeClient:
         with self._lock:
             if key not in self._store:
                 raise NotFoundError(f'{kind} "{name}" not found')
+            # optimistic concurrency: an update carrying a stale
+            # resourceVersion is rejected like a real API server's 409
+            sent_rv = meta.get('resourceVersion')
+            stored_rv = (self._store[key].get('metadata') or {}).get(
+                'resourceVersion')
+            if sent_rv is not None and stored_rv is not None and \
+                    sent_rv != stored_rv:
+                raise ConflictError(
+                    f'{kind} "{name}": resourceVersion conflict '
+                    f'(sent {sent_rv}, current {stored_rv})')
             if dry_run:
                 return obj
             self._rv += 1

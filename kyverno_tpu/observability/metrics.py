@@ -23,6 +23,7 @@ class MetricsRegistry:
     def __init__(self, disabled: Optional[List[str]] = None):
         self._lock = threading.Lock()
         self._counters: Dict[str, Dict[Tuple, float]] = {}
+        self._gauges: Dict[str, Dict[Tuple, float]] = {}
         self._hists: Dict[str, Dict[Tuple, List]] = {}
         self._disabled = set(disabled or [])
 
@@ -37,6 +38,26 @@ class MetricsRegistry:
         with self._lock:
             series = self._counters.setdefault(name, {})
             series[key] = series.get(key, 0.0) + amount
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        if name in self._disabled:
+            return
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            if value == 0.0:
+                series.pop(key, None)
+            else:
+                series[key] = value
+
+    def gauge_value(self, name: str, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._gauges.get(name, {}).get(key, 0.0)
+
+    def gauge_total(self, name: str) -> float:
+        with self._lock:
+            return sum(self._gauges.get(name, {}).values())
 
     def observe(self, name: str, value: float, **labels) -> None:
         if name in self._disabled:
@@ -72,6 +93,10 @@ class MetricsRegistry:
             for name in sorted(self._counters):
                 out.append(f'# TYPE {name} counter')
                 for key, value in sorted(self._counters[name].items()):
+                    out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
+            for name in sorted(self._gauges):
+                out.append(f'# TYPE {name} gauge')
+                for key, value in sorted(self._gauges[name].items()):
                     out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
             for name in sorted(self._hists):
                 out.append(f'# TYPE {name} histogram')

@@ -63,7 +63,7 @@ class WebhookServer:
                  policy_handlers: Optional[PolicyHandlers] = None,
                  exception_handlers: Optional[ExceptionHandlers] = None,
                  configuration=None,
-                 protection_enabled: bool = False,
+                 protection_enabled: Optional[bool] = None,
                  dump: bool = False,
                  host: str = '127.0.0.1', port: int = 9443,
                  certfile: Optional[str] = None,
@@ -80,6 +80,11 @@ class WebhookServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = False
+        if protection_enabled is None:
+            # env-tier feature toggle (reference: pkg/toggle/toggle.go:21
+            # ProtectManagedResources, consumed by handlers/protect.go)
+            from ..config.toggle import PROTECT_MANAGED_RESOURCES
+            protection_enabled = PROTECT_MANAGED_RESOURCES.enabled()
         self._routes = self._build_routes(protection_enabled)
 
     # -- handler chain ----------------------------------------------------
@@ -172,8 +177,36 @@ class WebhookServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
         if self.certfile:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(self.certfile, self.keyfile)
+            # per-handshake pair pickup (reference: server.go:155-177 reads
+            # the certmanager secret per TLS handshake): the SNI callback
+            # swaps in a freshly loaded context when the renewer rotates
+            # the files, so a running server serves the new pair without
+            # restart
+            outer = self
+            state = {'mtime': None, 'ctx': None}
+
+            def fresh_context():
+                import os
+                try:
+                    mtime = (os.stat(outer.certfile).st_mtime_ns,
+                             os.stat(outer.keyfile).st_mtime_ns)
+                except OSError:
+                    mtime = None
+                if state['ctx'] is None or mtime != state['mtime']:
+                    new = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                    new.load_cert_chain(outer.certfile, outer.keyfile)
+                    new.sni_callback = swap
+                    state['ctx'] = new
+                    state['mtime'] = mtime
+                return state['ctx']
+
+            def swap(sslobj, server_name, _ctx):
+                try:
+                    sslobj.context = fresh_context()
+                except Exception:  # noqa: BLE001 - keep serving old pair
+                    pass
+
+            ctx = fresh_context()
             self._httpd.socket = ctx.wrap_socket(
                 self._httpd.socket, server_side=True)
         self.port = self._httpd.server_address[1]

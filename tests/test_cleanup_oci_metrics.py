@@ -1,0 +1,228 @@
+"""CronJob-based cleanup contract, CLI oci push/pull, and the policy
+metrics controller (reference: pkg/controllers/cleanup/controller.go:164,
+cmd/cli/kubectl-kyverno/oci, pkg/controllers/metrics/policy)."""
+
+import json
+import urllib.request
+
+import yaml
+
+from kyverno_tpu.cmd.cleanup_controller import CleanupDaemon
+from kyverno_tpu.cmd.internal import Setup
+from kyverno_tpu.controllers.cleanup import CleanupController
+from kyverno_tpu.controllers.policymetrics import (POLICY_RULE_INFO,
+                                                  PolicyMetricsController)
+from kyverno_tpu.dclient.client import FakeClient
+from kyverno_tpu.observability.metrics import (POLICY_CHANGES,
+                                               MetricsRegistry)
+
+CLEANUP_POLICY = {
+    'apiVersion': 'kyverno.io/v2alpha1', 'kind': 'ClusterCleanupPolicy',
+    'metadata': {'name': 'sweep-temps', 'uid': 'u-123'},
+    'spec': {
+        'schedule': '*/5 * * * *',
+        'match': {'any': [{'resources': {
+            'kinds': ['ConfigMap'],
+            'selector': {'matchLabels': {'temp': 'true'}}}}]},
+    }}
+
+
+class TestCleanupCronJobs:
+    def test_cronjob_reconciled(self):
+        client = FakeClient()
+        ctrl = CleanupController(client)
+        ctrl.set_policy(CLEANUP_POLICY)
+        [cj] = ctrl.reconcile_cronjobs('kyverno')
+        assert cj['kind'] == 'CronJob'
+        assert cj['spec']['schedule'] == '*/5 * * * *'
+        assert cj['spec']['concurrencyPolicy'] == 'Forbid'
+        [owner] = cj['metadata']['ownerReferences']
+        assert owner['kind'] == 'ClusterCleanupPolicy'
+        assert owner['name'] == 'sweep-temps'
+        args = cj['spec']['jobTemplate']['spec']['template']['spec'][
+            'containers'][0]['args']
+        assert any('/cleanup?policy=sweep-temps' in a for a in args)
+        # stored in the fake cluster
+        stored = client.list_resource('batch/v1', 'CronJob', 'kyverno',
+                                      None)
+        assert [c['metadata']['name'] for c in stored] == \
+            ['cleanup-sweep-temps']
+
+    def test_stale_cronjob_removed(self):
+        client = FakeClient()
+        ctrl = CleanupController(client)
+        ctrl.set_policy(CLEANUP_POLICY)
+        ctrl.reconcile_cronjobs('kyverno')
+        ctrl.delete_policy(CLEANUP_POLICY)
+        ctrl.reconcile_cronjobs('kyverno')
+        assert client.list_resource('batch/v1', 'CronJob', 'kyverno',
+                                    None) == []
+
+    def test_cleanup_http_endpoint(self):
+        client = FakeClient()
+        client.create_resource('kyverno.io/v2alpha1',
+                               'ClusterCleanupPolicy', '', CLEANUP_POLICY)
+        client.create_resource('v1', 'ConfigMap', 'default', {
+            'apiVersion': 'v1', 'kind': 'ConfigMap',
+            'metadata': {'name': 'tmp', 'namespace': 'default',
+                         'labels': {'temp': 'true'}}})
+        setup = Setup('cleanup', args=[])
+        setup.client = client
+        daemon = CleanupDaemon(setup)
+        daemon.sync_policies()
+        port = daemon.server.start()
+        try:
+            body = urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/cleanup?policy=sweep-temps'
+            ).read().decode()
+            assert 'cleaned 1 resources' in body
+            assert client.list_resource('v1', 'ConfigMap', 'default',
+                                        None) == []
+            # unknown policy → 404
+            try:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/cleanup?policy=nope')
+                raise AssertionError('expected 404')
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            daemon.server.stop()
+
+
+class TestOCI:
+    POLICY_YAML = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: require-labels}
+spec:
+  rules:
+    - name: check-app
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: app label required
+        pattern: {metadata: {labels: {app: "?*"}}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata: {name: disallow-latest}
+spec:
+  rules:
+    - name: no-latest
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: no latest tag
+        pattern: {spec: {containers: [{image: "!*:latest"}]}}
+"""
+
+    def test_push_pull_roundtrip(self, tmp_path):
+        src = tmp_path / 'policies.yaml'
+        src.write_text(self.POLICY_YAML)
+        store = tmp_path / 'store'
+        from kyverno_tpu.cli import oci_command
+        digest = oci_command.push([str(src)], f'{store}:v1')
+        assert digest.startswith('sha256:')
+        # standard OCI layout on disk
+        assert (store / 'oci-layout').exists()
+        assert (store / 'index.json').exists()
+        out = tmp_path / 'out'
+        written = oci_command.pull(f'{store}:v1', str(out))
+        assert sorted(p.rsplit('/', 1)[-1] for p in written) == \
+            ['disallow-latest.yaml', 'require-labels.yaml']
+        docs = [yaml.safe_load(open(p)) for p in written]
+        originals = list(yaml.safe_load_all(self.POLICY_YAML))
+        assert sorted(d['metadata']['name'] for d in docs) == \
+            sorted(d['metadata']['name'] for d in originals)
+        # bit-exact policy documents round-trip
+        by_name = {d['metadata']['name']: d for d in docs}
+        for orig in originals:
+            assert by_name[orig['metadata']['name']] == orig
+
+    def test_cli_entrypoint(self, tmp_path, capsys):
+        src = tmp_path / 'p.yaml'
+        src.write_text(self.POLICY_YAML)
+        from kyverno_tpu.cli.main import main
+        assert main(['oci', 'push', str(src),
+                     '-i', f'{tmp_path}/store:latest']) == 0
+        assert main(['oci', 'pull', '-i', f'{tmp_path}/store:latest',
+                     '-o', str(tmp_path / 'pulled')]) == 0
+        out = capsys.readouterr().out
+        assert 'pushed' in out and 'pulled 2 policies' in out
+
+    def test_blob_corruption_detected(self, tmp_path):
+        import os
+        src = tmp_path / 'p.yaml'
+        src.write_text(self.POLICY_YAML)
+        store = str(tmp_path / 'store')
+        from kyverno_tpu.cli import oci_command
+        oci_command.push([str(src)], f'{store}:v1')
+        blobs_dir = os.path.join(store, 'blobs', 'sha256')
+        victim = sorted(os.listdir(blobs_dir))[0]
+        with open(os.path.join(blobs_dir, victim), 'ab') as f:
+            f.write(b'tampered')
+        import pytest
+        with pytest.raises(ValueError, match='corrupted'):
+            oci_command.pull(f'{store}:v1', str(tmp_path / 'out'))
+
+
+POLICY_DOC = {
+    'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+    'metadata': {'name': 'metered'},
+    'spec': {'validationFailureAction': 'Enforce', 'rules': [
+        {'name': 'r1',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'validate': {'message': 'm',
+                      'pattern': {'metadata': {'name': '?*'}}}},
+        {'name': 'r2',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'mutate': {'patchStrategicMerge': {'metadata': {'labels': {
+             'x': 'y'}}}}},
+    ]}}
+
+
+class TestPolicyMetrics:
+    def test_policy_events_move_instruments(self):
+        client = FakeClient()
+        registry = MetricsRegistry()
+        PolicyMetricsController(client, registry)
+
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               POLICY_DOC)
+        assert registry.counter_value(
+            POLICY_CHANGES, policy_change_type='created',
+            policy_name='metered', policy_namespace='-',
+            policy_type='cluster', policy_validation_mode='enforce',
+            policy_background_mode='true') == 1
+        assert registry.gauge_total(POLICY_RULE_INFO) == 2
+
+        updated = json.loads(json.dumps(POLICY_DOC))
+        updated['spec']['rules'] = updated['spec']['rules'][:1]
+        client.update_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               updated)
+        # rule gauge re-derived: r2 retracted
+        assert registry.gauge_total(POLICY_RULE_INFO) == 1
+
+        client.delete_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               'metered')
+        assert registry.gauge_total(POLICY_RULE_INFO) == 0
+        assert registry.counter_total(POLICY_CHANGES) == 3
+        # rendered exposition includes the gauge type
+        assert 'kyverno_policy_changes_total' in registry.render()
+
+    def test_rule_types_labeled(self):
+        client = FakeClient()
+        registry = MetricsRegistry()
+        PolicyMetricsController(client, registry)
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '',
+                               POLICY_DOC)
+        assert registry.gauge_value(
+            POLICY_RULE_INFO, policy_name='metered',
+            policy_namespace='-', policy_type='cluster',
+            policy_validation_mode='enforce',
+            policy_background_mode='true', rule_name='r1',
+            rule_type='validate') == 1
+        assert registry.gauge_value(
+            POLICY_RULE_INFO, policy_name='metered',
+            policy_namespace='-', policy_type='cluster',
+            policy_validation_mode='enforce',
+            policy_background_mode='true', rule_name='r2',
+            rule_type='mutate') == 1

@@ -202,3 +202,74 @@ class TestLeaderElection:
         assert a.try_acquire(now=201.0) is False
         b.release()
         assert a.try_acquire(now=202.0) is True
+
+    def test_acquire_is_compare_and_swap(self):
+        """Two replicas racing on an expired lease must not both win
+        (ADVICE r2: non-atomic read-modify-write split-brain)."""
+        from kyverno_tpu.controllers.leaderelection import LeaderElector
+        client = FakeClient()
+        a = LeaderElector(client, 'test-lease', identity='a')
+        b = LeaderElector(client, 'test-lease', identity='b')
+        assert a.try_acquire(now=100.0) is True
+        # b observes the expired lease, then a renews before b's update
+        # lands: b's CAS must fail (conflict) and re-read a's fresh renew
+        real_get = client.get_resource
+        raced = []
+
+        def racing_get(api, kind, ns, name, *args, **kw):
+            lease = real_get(api, kind, ns, name, *args, **kw)
+            if kind == 'Lease' and not raced:
+                raced.append(True)
+                a.try_acquire(now=200.0)  # a renews between b's read+write
+            return lease
+        client.get_resource = racing_get
+        assert b.try_acquire(now=200.0) is False
+        client.get_resource = real_get
+        assert a.is_leader() and not b.is_leader()
+
+    def test_renew_time_is_rfc3339_microtime(self):
+        """coordination.k8s.io/v1 renewTime must interoperate with
+        client-go holders (RFC3339 MicroTime, not an epoch float)."""
+        from kyverno_tpu.controllers.leaderelection import (
+            LeaderElector, _parse_microtime)
+        client = FakeClient()
+        a = LeaderElector(client, 'test-lease', identity='a')
+        a.try_acquire(now=1753833600.125)
+        lease = client.get_resource('coordination.k8s.io/v1', 'Lease',
+                                    'kyverno', 'test-lease')
+        renew = lease['spec']['renewTime']
+        assert isinstance(renew, str) and renew.endswith('Z')
+        assert 'T' in renew
+        assert abs(_parse_microtime(renew) - 1753833600.125) < 1e-5
+        # a client-go-style holder's value parses too
+        assert _parse_microtime('2026-07-30T00:00:00.500000Z') > 0
+        # legacy epoch floats remain readable
+        assert _parse_microtime(100.5) == 100.5
+
+
+class TestToggles:
+    def test_env_and_flag_tiers(self, monkeypatch):
+        from kyverno_tpu.config.toggle import Toggle
+        t = Toggle(False, 'FLAG_X_TEST')
+        assert t.enabled() is False
+        monkeypatch.setenv('FLAG_X_TEST', 'true')
+        assert t.enabled() is True
+        t.parse('false')  # flag tier wins over env
+        assert t.enabled() is False
+        t.reset()
+        assert t.enabled() is True
+
+    def test_force_failure_policy_ignore(self, monkeypatch):
+        from kyverno_tpu.api.policy import Policy
+        from kyverno_tpu.controllers.webhook import WebhookConfigReconciler
+        monkeypatch.setenv('FLAG_FORCE_FAILURE_POLICY_IGNORE', 'true')
+        client = FakeClient()
+        rec = WebhookConfigReconciler(client, b'ca', 'kyverno')
+        pol = Policy(AUDIT_POLICY)
+        rec.reconcile([pol])
+        configs = client.list_resource(
+            'admissionregistration.k8s.io/v1',
+            'ValidatingWebhookConfiguration', '', None)
+        hooks = [w for c in configs for w in c.get('webhooks', [])]
+        assert hooks and all(
+            w.get('failurePolicy') == 'Ignore' for w in hooks)

