@@ -167,6 +167,10 @@ def pull(ref: str, output_dir: str) -> List[str]:
         data = layout.get_blob(layer['digest'])
         name = (layer.get('annotations') or {}).get(
             'io.kyverno.image.name') or f'policy-{i}'
+        # the annotation is attacker-controlled content from the pulled
+        # artifact: strip any path components so writes cannot escape
+        # output_dir
+        name = os.path.basename(name.replace('\\', '/')) or f'policy-{i}'
         # same-named policies (e.g. cluster + namespaced 'restrict') must
         # not overwrite each other
         if name in used:

@@ -21,11 +21,23 @@ class CleanupHTTPServer:
     """Serves GET /cleanup?policy=<ns/name>
     (reference: cmd/cleanup-controller/handlers/cleanup)."""
 
-    def __init__(self, controller: CleanupController, port: int = 0):
+    def __init__(self, controller: CleanupController, port: int = 0,
+                 host: str = '', certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
+        # default bind is all interfaces: the CronJobs this controller
+        # reconciles call back via the cluster Service address, which a
+        # localhost-only listener could never serve
         self.controller = controller
+        self.host = host
         self.port = port
+        self.certfile = certfile
+        self.keyfile = keyfile
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def scheme(self) -> str:
+        return 'https' if self.certfile else 'http'
 
     def start(self) -> int:
         controller = self.controller
@@ -53,8 +65,13 @@ class CleanupHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer(('127.0.0.1', self.port),
-                                          _Handler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        if self.certfile:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name='ktpu-cleanup', daemon=True)
@@ -69,10 +86,13 @@ class CleanupHTTPServer:
 
 
 class CleanupDaemon:
-    def __init__(self, setup: Setup, http_port: int = 0):
+    def __init__(self, setup: Setup, http_port: int = 0,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
         self.setup = setup
         self.controller = CleanupController(setup.client)
-        self.server = CleanupHTTPServer(self.controller, http_port)
+        self.server = CleanupHTTPServer(self.controller, http_port,
+                                        certfile=certfile, keyfile=keyfile)
 
     def sync_policies(self) -> None:
         seen = set()
@@ -94,7 +114,12 @@ class CleanupDaemon:
         if not mesh_is_leader():
             return
         self.sync_policies()
-        self.controller.reconcile_cronjobs(self.setup.options.namespace)
+        # the callback URL's scheme must match how the server actually
+        # serves, or every reconciled CronJob would fail its curl forever
+        ns = self.setup.options.namespace
+        self.controller.reconcile_cronjobs(
+            ns, service=f'{self.server.scheme}://cleanup-controller.'
+                        f'{ns}.svc')
         self.controller.tick()
 
     def run(self) -> None:

@@ -11,6 +11,7 @@ dynamic client.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -133,10 +134,14 @@ class CleanupController:
             meta = doc.get('metadata') or {}
             pol_ns = meta.get('namespace', '')
             kind = 'CleanupPolicy' if pol_ns else 'ClusterCleanupPolicy'
-            # the policy namespace is part of the name: same-named
-            # policies in different namespaces must not collide
-            name = f"cleanup-{pol_ns}-{meta.get('name', '')}" if pol_ns \
+            # a flat ns+name join is ambiguous ('a-b' vs ns a / name b);
+            # an 8-hex digest of kind+key makes the CronJob name unique
+            # per policy and keeps it inside the 52-char CronJob limit
+            digest = hashlib.sha256(f'{kind}/{key}'.encode()) \
+                .hexdigest()[:8]
+            base = f"cleanup-{pol_ns}-{meta.get('name', '')}" if pol_ns \
                 else f"cleanup-{meta.get('name', '')}"
+            name = f'{base[:43].rstrip("-")}-{digest}'
             cronjob = {
                 'apiVersion': 'batch/v1', 'kind': 'CronJob',
                 'metadata': {
@@ -184,6 +189,12 @@ class CleanupController:
             if existing is None:
                 out.append(self.client.create_resource(
                     'batch/v1', 'CronJob', namespace, cronjob))
+            elif (existing.get('spec') == cronjob['spec'] and
+                  existing['metadata'].get('ownerReferences') ==
+                  cronjob['metadata']['ownerReferences']):
+                # unchanged: no write (the reference controller compares
+                # observed vs desired before updating)
+                out.append(existing)
             else:
                 existing['spec'] = cronjob['spec']
                 existing['metadata']['ownerReferences'] = \

@@ -32,6 +32,18 @@ class PolicyMetricsController:
         # (policy key) → {rule label-tuples} for gauge retraction
         self._rules: Dict[str, set] = {}
         client.watch(self._on_event)
+        # informers replay ADDED for objects that exist before the watch
+        # starts (controller.go informer cache sync) — list and seed the
+        # rule-info gauges so a restart doesn't zero the series
+        for api_version, kind in (('kyverno.io/v1', 'ClusterPolicy'),
+                                  ('kyverno.io/v1', 'Policy')):
+            try:
+                existing = client.list_resource(api_version, kind)
+            except Exception:  # noqa: BLE001 - kind may not be served
+                continue
+            for resource in existing:
+                resource.setdefault('kind', kind)
+                self._sync_rule_info(Policy(resource))
 
     @staticmethod
     def _labels(policy: Policy) -> dict:
@@ -56,13 +68,17 @@ class PolicyMetricsController:
                   'DELETED': 'deleted'}.get(event, event)
         self.registry.inc(POLICY_CHANGES,
                           policy_change_type=change, **labels)
+        self._sync_rule_info(policy, deleted=change == 'deleted')
+
+    def _sync_rule_info(self, policy: Policy, deleted: bool = False) -> None:
+        labels = self._labels(policy)
         key = f'{policy.namespace}/{policy.name}'
         with self._lock:
             # retract the previous rule-info series for this policy
             for old in self._rules.pop(key, set()):
                 self.registry.set_gauge(POLICY_RULE_INFO, 0.0,
                                         **dict(old))
-            if change == 'deleted':
+            if deleted:
                 return
             current = set()
             for rule in policy.rules:

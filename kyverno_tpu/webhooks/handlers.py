@@ -217,6 +217,12 @@ class ResourceHandlers:
     generate / mutate-existing policies (reference: handlers.go:146-155).
     """
 
+    # consecutive device-scan failures before the device fast path is
+    # disabled for the handler's lifetime (each failure already pays a
+    # scanner rebuild; a persistently broken backend must not recompile
+    # the policy set on every request)
+    DEVICE_FAILURE_LIMIT = 3
+
     def __init__(self, cache: 'pcache.Cache', engine: Optional[Engine] = None,
                  pc_builder: Optional[admission.PolicyContextBuilder] = None,
                  configuration=None,
@@ -244,6 +250,7 @@ class ResourceHandlers:
         self.device = device
         self._scanner = None
         self._scanner_policies = None
+        self._device_failures = 0
 
     def _device_scanner(self, policies):
         if self._scanner_policies is not policies and \
@@ -280,15 +287,38 @@ class ResourceHandlers:
                       request.get('operation') == 'CREATE' and
                       not pctx.exceptions)
         if use_device:
-            scanner = self._device_scanner(policies)
-            resource = admission.request_resource(request)
-            [responses] = scanner.scan(
-                [resource],
-                contexts=[pctx.json_context._data],
-                admission=(pctx.admission_info, pctx.exclude_group_roles,
-                           pctx.namespace_labels, 'CREATE'),
-                pctx_factory=lambda doc: pctx)
-        else:
+            try:
+                scanner = self._device_scanner(policies)
+                resource = admission.request_resource(request)
+                [responses] = scanner.scan(
+                    [resource],
+                    contexts=[pctx.json_context._data],
+                    admission=(pctx.admission_info, pctx.exclude_group_roles,
+                               pctx.namespace_labels, 'CREATE'),
+                    pctx_factory=lambda doc: pctx)
+            except Exception as e:  # noqa: BLE001
+                # device failure must not turn into a 500: drop to the
+                # host engine loop and discard the broken scanner so the
+                # next request rebuilds it (failure recovery, SURVEY §5.3).
+                # Repeated failures disable the device path entirely —
+                # otherwise every request would pay a full policy-set
+                # recompile before falling back.
+                self._scanner = None
+                self._scanner_policies = None
+                self._device_failures += 1
+                import logging
+                from ..observability.logging import with_values
+                log = logging.getLogger('kyverno.webhooks')
+                with_values(log, 'device scan failed, falling back to '
+                            'host engine', level=logging.ERROR,
+                            error=str(e), failures=self._device_failures)
+                if self._device_failures >= self.DEVICE_FAILURE_LIMIT:
+                    with_values(log, 'device path disabled after repeated '
+                                'failures', level=logging.ERROR)
+                    self.device = False
+                use_device = False
+                responses = []
+        if not use_device:
             for policy in policies:
                 ctx = pctx.copy()
                 ctx.policy = policy

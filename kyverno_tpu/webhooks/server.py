@@ -178,37 +178,39 @@ class WebhookServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
         if self.certfile:
             # per-handshake pair pickup (reference: server.go:155-177 reads
-            # the certmanager secret per TLS handshake): the SNI callback
-            # swaps in a freshly loaded context when the renewer rotates
-            # the files, so a running server serves the new pair without
-            # restart
+            # the certmanager secret per TLS handshake): before each
+            # accept, a rotated cert/key pair is reloaded into the live
+            # SSLContext, so new handshakes serve the fresh pair without
+            # restart.  This covers every client — an SNI callback alone
+            # would miss clients that connect by IP and send no SNI.
             outer = self
-            state = {'mtime': None, 'ctx': None}
+            state = {'mtime': None}
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
 
-            def fresh_context():
+            def reload_if_rotated():
                 import os
                 try:
                     mtime = (os.stat(outer.certfile).st_mtime_ns,
                              os.stat(outer.keyfile).st_mtime_ns)
                 except OSError:
-                    mtime = None
-                if state['ctx'] is None or mtime != state['mtime']:
-                    new = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-                    new.load_cert_chain(outer.certfile, outer.keyfile)
-                    new.sni_callback = swap
-                    state['ctx'] = new
-                    state['mtime'] = mtime
-                return state['ctx']
+                    return
+                if mtime != state['mtime']:
+                    try:
+                        ctx.load_cert_chain(outer.certfile, outer.keyfile)
+                        state['mtime'] = mtime
+                    except Exception:  # noqa: BLE001 - keep old pair
+                        if state['mtime'] is None:
+                            raise  # first load must succeed
 
-            def swap(sslobj, server_name, _ctx):
-                try:
-                    sslobj.context = fresh_context()
-                except Exception:  # noqa: BLE001 - keep serving old pair
-                    pass
-
-            ctx = fresh_context()
+            reload_if_rotated()
             self._httpd.socket = ctx.wrap_socket(
                 self._httpd.socket, server_side=True)
+            inner_get_request = self._httpd.get_request
+
+            def get_request():
+                reload_if_rotated()
+                return inner_get_request()
+            self._httpd.get_request = get_request
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)

@@ -2,6 +2,7 @@
 metrics controller (reference: pkg/controllers/cleanup/controller.go:164,
 cmd/cli/kubectl-kyverno/oci, pkg/controllers/metrics/policy)."""
 
+import re
 import json
 import urllib.request
 
@@ -45,8 +46,10 @@ class TestCleanupCronJobs:
         # stored in the fake cluster
         stored = client.list_resource('batch/v1', 'CronJob', 'kyverno',
                                       None)
-        assert [c['metadata']['name'] for c in stored] == \
-            ['cleanup-sweep-temps']
+        [name] = [c['metadata']['name'] for c in stored]
+        # name = prefix + 8-hex digest of kind/key (collision-free for
+        # e.g. ClusterCleanupPolicy 'a-b' vs CleanupPolicy a/b)
+        assert re.fullmatch(r'cleanup-sweep-temps-[0-9a-f]{8}', name)
 
     def test_stale_cronjob_removed(self):
         client = FakeClient()
