@@ -122,6 +122,377 @@ def make_pod(rng, i: int) -> dict:
             'spec': spec}
 
 
+# --------------------------------------------------------------------------
+# BASELINE config 4: JMESPath-heavy precondition/deny policies.  Every
+# condition key is a real JMESPath program (filters, functions, ||
+# defaults) evaluated per resource at encode time, then decided on
+# device — the workload BASELINE.md row 4 describes.
+
+CONFIG4_PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: limit-containers
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: max-3-containers
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.object.metadata.labels.tier || 'none' }}"
+            operator: AnyIn
+            value: [web, api]
+      validate:
+        message: "tiered pods are limited to 3 containers"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.containers) }}"
+                operator: GreaterThan
+                value: 3
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-tagged-images
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: no-latest-or-untagged
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "images must carry a non-latest tag"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.containers[?contains(image, ':latest')]) }}"
+                operator: GreaterThan
+                value: 0
+              - key: "{{ length(request.object.spec.containers[?!contains(image, ':')]) }}"
+                operator: GreaterThan
+                value: 0
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-probes
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: liveness-required
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.object.metadata.labels.app || '' }}"
+            operator: NotEquals
+            value: ""
+      validate:
+        message: "app pods need liveness probes on every container"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.containers[?livenessProbe == null]) }}"
+                operator: GreaterThan
+                value: 0
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: digest-pin-prod
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: prod-pins-digests
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.object.metadata.labels.env || '' }}"
+            operator: Equals
+            value: prod
+      validate:
+        message: "prod images must be pinned by digest"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.containers[?!contains(image, '@sha256:')]) }}"
+                operator: GreaterThan
+                value: 0
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: hostpath-quarantine
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: no-hostpath-outside-system
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.object.metadata.namespace }}"
+            operator: AnyNotIn
+            value: [kube-system]
+      validate:
+        message: "hostPath volumes are quarantined to kube-system"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.volumes[?hostPath] || `[]`) }}"
+                operator: GreaterThan
+                value: 0
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: sysctl-allowlist
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: net-sysctls-only
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "only net.* sysctls are allowed"
+        deny:
+          conditions:
+            any:
+              - key: "{{ length(request.object.spec.securityContext.sysctls[?!starts_with(name, 'net.')] || `[]`) }}"
+                operator: GreaterThan
+                value: 0
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: resource-budget
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: cpu-annotation-budget
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      preconditions:
+        all:
+          - key: "{{ request.object.metadata.annotations.\\"budget.io/max-cpu\\" || '0' }}"
+            operator: NotEquals
+            value: '0'
+      validate:
+        message: "declared cpu budget exceeds the cluster cap of 16"
+        deny:
+          conditions:
+            any:
+              - key: "{{ to_number(request.object.metadata.annotations.\\"budget.io/max-cpu\\") }}"
+                operator: GreaterThan
+                value: 16
+"""
+
+
+def make_config4_pod(rng, i: int) -> dict:
+    pod = make_pod(rng, i)
+    labels = pod['metadata'].setdefault('labels', {})
+    if rng.random() < 0.6:
+        labels['tier'] = rng.choice(['web', 'api', 'batch', 'cache'])
+    if rng.random() < 0.3:
+        labels['env'] = rng.choice(['prod', 'staging'])
+    if rng.random() < 0.25:
+        pod['metadata']['annotations'] = {
+            'budget.io/max-cpu': str(rng.choice([2, 8, 24]))}
+    if rng.random() < 0.4:
+        for cont in pod['spec']['containers']:
+            if rng.random() < 0.7:
+                cont['livenessProbe'] = {
+                    'httpGet': {'path': '/healthz', 'port': 8080}}
+    if rng.random() < 0.1:
+        pod['spec']['containers'][0]['image'] = \
+            'gcr.io/proj/svc@sha256:' + '0' * 64
+    return pod
+
+
+def run_config4(n: int, platform: str) -> dict:
+    """BASELINE config 4 (scaled): JMESPath-heavy pack over n Pods."""
+    import random
+    from kyverno_tpu.api.policy import load_policies_from_yaml
+    from kyverno_tpu.compiler.scan import BatchScanner
+
+    policies = load_policies_from_yaml(CONFIG4_PACK)
+    rng = random.Random(7)
+    resources = [make_config4_pod(rng, i) for i in range(n)]
+    t0 = time.time()
+    scanner = BatchScanner(policies)
+    compile_s = time.time() - t0
+    t_warm = time.time()
+    scanner.scan(resources[:min(n, scanner.CHUNK + 1)])
+    warm_s = time.time() - t_warm
+    t1 = time.time()
+    out = scanner.scan(resources)
+    scan_s = time.time() - t1
+    decisions = sum(len(r.policy_response.rules)
+                    for responses in out for r in responses)
+    return {
+        'metric': 'config4_jmespath_decisions_per_sec_per_chip',
+        'value': round(decisions / scan_s, 1) if scan_s else 0.0,
+        'unit': 'decisions/s',
+        'vs_baseline': round(decisions / scan_s / PER_CHIP_TARGET, 3)
+        if scan_s else 0.0,
+        'platform': platform, 'n_resources': n,
+        'n_policies': len(policies),
+        'n_compiled_rules': len(scanner.cps.programs),
+        'n_host_rules': len(scanner.cps.host_rules),
+        'decisions': decisions,
+        'compile_s': round(compile_s, 2), 'warm_s': round(warm_s, 2),
+        'scan_s': round(scan_s, 2),
+    }
+
+
+# --------------------------------------------------------------------------
+# BASELINE config 5: mutate + generate with foreach over a resource dump.
+
+CONFIG5_PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-managed-labels
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: managed-label
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              managed: "true"
+              +(costcenter): "unassigned"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: pull-policy-foreach
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: set-pull-policy
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        foreach:
+          - list: "request.object.spec.containers"
+            preconditions:
+              all:
+                - key: "{{ element.imagePullPolicy || '' }}"
+                  operator: Equals
+                  value: ""
+            patchStrategicMerge:
+              spec:
+                containers:
+                  - name: "{{ element.name }}"
+                    imagePullPolicy: IfNotPresent
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: annotate-revision
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: revision-annotation
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchesJson6902: |-
+          - op: add
+            path: /metadata/annotations/policy.io~1revision
+            value: "r1"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: default-deny-netpol
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: default-deny
+      match: {any: [{resources: {kinds: [Namespace]}}]}
+      generate:
+        apiVersion: networking.k8s.io/v1
+        kind: NetworkPolicy
+        name: default-deny
+        namespace: "{{ request.object.metadata.name }}"
+        data:
+          spec:
+            podSelector: {}
+            policyTypes: [Ingress, Egress]
+"""
+
+
+def make_config5_resource(rng, i: int) -> dict:
+    # ~1 Namespace per 50 Pods, like a real dump
+    if i % 50 == 49:
+        return {'apiVersion': 'v1', 'kind': 'Namespace',
+                'metadata': {'name': f'team-{i // 50}'}}
+    pod = make_pod(rng, i)
+    if rng.random() < 0.3:
+        for cont in pod['spec']['containers']:
+            cont['imagePullPolicy'] = 'Always'
+    return pod
+
+
+def run_config5(n: int, platform: str) -> dict:
+    """BASELINE config 5 (scaled): mutate+generate foreach over a dump,
+    fanned over a host process pool; generate URs feed the real
+    background pipeline."""
+    import random
+    from kyverno_tpu.api.policy import load_policies_from_yaml
+    from kyverno_tpu.compiler.apply import BatchApplier
+
+    policies = load_policies_from_yaml(CONFIG5_PACK)
+    rng = random.Random(11)
+    resources = [make_config5_resource(rng, i) for i in range(n)]
+    applier = BatchApplier(policies)
+    applier.apply(resources[:64])  # warm worker-side imports
+    t0 = time.time()
+    results = applier.apply(resources)
+    apply_s = time.time() - t0
+    applications = sum(len(r.rule_results) for r in results)
+    ur_specs = [spec for r in results for spec in r.ur_specs]
+    # drive a sample of the generate URs through the real background
+    # controller to include the generate cost in the reported rate
+    from kyverno_tpu.background.update_request_controller import \
+        UpdateRequestController
+    from kyverno_tpu.background.updaterequest import UpdateRequestGenerator
+    from kyverno_tpu.dclient.client import FakeClient
+    from kyverno_tpu.engine.engine import Engine
+    client = FakeClient()
+    by_name = {p.name: p for p in policies}
+    for res in resources:
+        if res.get('kind') == 'Namespace':
+            client.create_resource('v1', 'Namespace', '', res)
+    ctrl = UpdateRequestController(client, Engine(),
+                                   policy_getter=by_name.get)
+    gen = UpdateRequestGenerator(client)
+    t1 = time.time()
+    for spec in ur_specs:
+        gen.apply(spec)
+    processed = ctrl.process_pending()
+    generate_s = time.time() - t1
+    netpols = client.list_resource('networking.k8s.io/v1',
+                                   'NetworkPolicy')
+    total_s = apply_s + generate_s
+    return {
+        'metric': 'config5_mutate_generate_applies_per_sec',
+        'value': round((applications + processed) / total_s, 1)
+        if total_s else 0.0,
+        'unit': 'applies/s',
+        'vs_baseline': round(len(resources) / total_s / PER_CHIP_TARGET, 3)
+        if total_s else 0.0,
+        'platform': platform, 'n_resources': n,
+        'n_policies': len(policies),
+        'rule_applications': applications,
+        'resources_per_sec': round(len(resources) / total_s, 1)
+        if total_s else 0.0,
+        'ur_processed': processed,
+        'netpols_generated': len(netpols),
+        'apply_s': round(apply_s, 2), 'generate_s': round(generate_s, 2),
+        'processes': applier.processes,
+    }
+
+
 def probe_platform() -> str:
     """Probe the default JAX backend in a subprocess (init failures are
     sticky in-process); returns the platform to use."""
@@ -383,8 +754,16 @@ def main() -> int:
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
         import jax
         jax.config.update('jax_platforms', 'cpu')
+    # BENCH_CONFIG=4|5 runs the scaled BASELINE configs; default is the
+    # north-star background scan
+    config = os.environ.get('BENCH_CONFIG', '')
     try:
-        result = run_bench(n, platform)
+        if config == '4':
+            result = run_config4(n, platform)
+        elif config == '5':
+            result = run_config5(n, platform)
+        else:
+            result = run_bench(n, platform)
     except Exception as e:  # noqa: BLE001 - always emit a JSON line
         import traceback
         traceback.print_exc()

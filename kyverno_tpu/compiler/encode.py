@@ -295,7 +295,10 @@ class Lanes:
 # read-set of ops/eval.py for each leaf op / condition check)
 
 def _blen(s: str) -> int:
-    return min(len(s.encode('utf-8')), STR_LEN)
+    # floor 1: ops that compare against '' still read the str_head lane
+    # (eval.py eq_const), so the window must exist even for empty
+    # constants
+    return min(max(len(s.encode('utf-8')), 1), STR_LEN)
 
 
 def _leaf_needs(op: str, operand: Any) -> LaneNeeds:
@@ -594,6 +597,17 @@ def _measure_elems(resources: List[dict], containers: List[Tuple]) -> int:
     return longest
 
 
+def _has_null_dict_value(v) -> bool:
+    """True when RFC-7386 merging would change ``v`` — i.e. some dict
+    reachable through dicts has a None value (merge_patch does not
+    descend into lists)."""
+    if isinstance(v, dict):
+        for x in v.values():
+            if x is None or _has_null_dict_value(x):
+                return True
+    return False
+
+
 def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  padded_n: int = 0,
                  contexts: Optional[List[dict]] = None) -> Batch:
@@ -616,10 +630,17 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
     # stripped; engine/context.py:36 merge_patch) — a variable resolving
     # to an explicit null must raise NotFound exactly like the host
     from ..engine.context import merge_patch
+
+    def _merged(doc: dict) -> dict:
+        # merge_patch only rewrites dicts (lists pass by reference), so
+        # a doc with no null dict values merges to an equal structure —
+        # skip the rebuild, which otherwise dominates context setup
+        return merge_patch({}, doc) if _has_null_dict_value(doc) else doc
+
     if contexts is not None:
-        bases = [merge_patch({}, c) for c in contexts]
+        bases = [_merged(c) for c in contexts]
     else:
-        bases = [merge_patch({}, {'request': {'object': doc}})
+        bases = [{'request': {'object': _merged(doc)}}
                  for doc in resources]
     gather_results = {
         g: [_run_gather_ctx(searcher, base) for base in bases]
@@ -656,10 +677,14 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                     row.append(('null', None))
                     continue
                 # element context merges over the base like the host's
-                # add_element (context.py:109) — nulls stripped again
-                ctx = merge_patch(bases[r], {
-                    'element': elem, 'element0': elem,
-                    'elementIndex': fe, 'elementIndex0': fe})
+                # add_element (context.py:109) — nulls stripped again;
+                # the merge only rewrites the element subtree, so build
+                # the top level directly and strip just the element
+                stripped = merge_patch({}, elem) \
+                    if _has_null_dict_value(elem) else elem
+                ctx = {**bases[r],
+                       'element': stripped, 'element0': stripped,
+                       'elementIndex': fe, 'elementIndex0': fe}
                 m2, v2 = _run_gather_ctx(searcher, ctx)
                 if m2 == 'list':
                     longest_eg = max(longest_eg, len(v2))

@@ -41,6 +41,9 @@ _SIMPLE_MATCH_KEYS = {'kinds', 'namespaces', 'operations'}
 
 PRECONDITIONS_SKIP_MESSAGE = 'preconditions not met'
 
+# sentinel: a device cell that must be re-run on the host engine
+_HOST_MARKER = object()
+
 # ---------------------------------------------------------------------------
 # Encoder process pool: encode_batch is pure numpy/Python (no jax), so
 # chunks encode in forked workers off the main interpreter's GIL — the
@@ -178,9 +181,14 @@ class BatchScanner:
         self._match_cache_lock = __import__('threading').Lock()
         self._rules = [Rule(p.rule_raw or {}) for p in self.cps.programs]
         self._fail_msg_cache: Dict[Tuple, Optional[str]] = {}
+        # forked encode workers only pay off with spare cores: on a
+        # single-CPU host the ~150MB/chunk lane tensors pickled back
+        # through the pipe cost more CPU than the encode they offload
+        _os = __import__('os')
+        _default_procs = '2' if (_os.cpu_count() or 1) > 2 else '0'
         self._encoder_pool = _EncoderPool(
             self.cps,
-            int(__import__('os').environ.get('KTPU_ENCODE_PROCS', '2')))
+            int(_os.environ.get('KTPU_ENCODE_PROCS', _default_procs)))
         # static per-policy response header fields (avoids re-deriving
         # them from the raw policy dict per (resource, policy) pair)
         self._policy_header = [
@@ -452,77 +460,87 @@ class BatchScanner:
             {p: None for p in self._host_policy_idx}
 
         progs = self.cps.programs
-        dev_mask = np.zeros(len(progs), bool)
-        for j, _ in self.device_programs:
-            dev_mask[j] = True
         background_ok = np.array([
             self.policies[p.policy_index].background for p in progs])
 
         out: List[List[EngineResponse]] = []
         # the device chunks stream through while this loop assembles —
-        # three pipeline stages (encode / device / assemble) overlap
+        # three pipeline stages (encode / device / assemble) overlap.
+        # Assembly is column-wise (per program over the whole chunk):
+        # the status branch, message lookup and int casts amortize over
+        # all rows of a column, and identical device-synthesized cells
+        # share one flyweight RuleResponse (treat rule responses from
+        # scan() as immutable — every downstream consumer only reads).
+        _HOST = _HOST_MARKER
         for start, status, detail, fdet in \
                 self._device_status_chunks(resources, contexts):
-            for k in range(status.shape[0]):
+            m = status.shape[0]
+            sub_match = match[start:start + m]
+            # per-row [(policy_index, RuleResponse|None), ...] in j order
+            acc: List[list] = [[] for _ in range(m)]
+            for j, prog in self.device_programs:
+                rows = np.flatnonzero(sub_match[:, j])
+                if rows.size == 0:
+                    continue
+                p_idx = prog.policy_index
+                if background_mode and not background_ok[j]:
+                    # background-disabled policies contribute an empty
+                    # response (engine.py:174 apply_background_checks)
+                    for k in rows.tolist():
+                        acc[k].append((p_idx, None))
+                    continue
+                st_col = status[rows, j].tolist()
+                det_col = detail[rows, j].tolist()
+                flyweights: Dict[Tuple, Any] = {}
+                for k, st, det in zip(rows.tolist(), st_col, det_col):
+                    if st == STATUS_FAIL:
+                        # the fail-site detail row carries anyPattern
+                        # metadata beyond column j — _fail_message_cached
+                        # is itself memoized on the relevant columns
+                        msg = self._fail_message_cached(prog, j, fdet[k])
+                        if msg is None:
+                            rr = _HOST
+                        else:
+                            rr = flyweights.get(msg)
+                            if rr is None:
+                                rr = RuleResponse(prog.rule_name,
+                                                  RuleType.VALIDATION,
+                                                  msg, RuleStatus.FAIL)
+                                rr.timestamp = ts
+                                flyweights[msg] = rr
+                    else:
+                        key = (st, det)
+                        rr = flyweights.get(key)
+                        if rr is None:
+                            rr = self._synth_rule(prog, st, det, ts)
+                            flyweights[key] = rr
+                    if rr is _HOST:
+                        # anchor-SKIP / HOST / unsynthesizable FAIL:
+                        # re-run on the host for exact status+message
+                        rr = self._materialize(prog, resources[start + k])
+                        if rr is not None:
+                            rr.timestamp = ts
+                    acc[k].append((p_idx, None if rr is None or
+                                   rr is _HOST else rr))
+            for k in range(m):
                 i = start + k
                 res_doc = resources[i]
                 responses: Dict[int, EngineResponse] = {}
-                for j in np.nonzero(match[i] & dev_mask)[0]:
-                    j = int(j)
-                    prog = progs[j]
-                    if background_mode and not background_ok[j]:
-                        # background-disabled policies contribute an empty
-                        # response (engine.py:174 apply_background_checks)
-                        if prog.policy_index not in responses:
-                            responses[prog.policy_index] = \
-                                self._new_response(prog.policy_index,
-                                                   res_doc, now, wrapped[i])
-                        continue
-                    resp = responses.get(prog.policy_index)
+                for p_idx, rr in acc[k]:
+                    resp = responses.get(p_idx)
                     if resp is None:
-                        resp = self._new_response(prog.policy_index, res_doc,
-                                                  now, wrapped[i])
-                        responses[prog.policy_index] = resp
-                    st = int(status[k, j])
-                    if st == STATUS_PASS:
-                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                          prog.pass_messages[int(detail[k, j])],
-                                          RuleStatus.PASS)
-                        if prog.pss is not None:
-                            rr.pod_security_checks = {
-                                'level': prog.pss[0], 'version': prog.pss[1],
-                                'checks': []}
-                    elif st == STATUS_SKIP_PRECOND:
-                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                          PRECONDITIONS_SKIP_MESSAGE,
-                                          RuleStatus.SKIP)
-                    elif st == STATUS_VAR_ERR:
-                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                          prog.error_messages[int(detail[k, j])],
-                                          RuleStatus.ERROR)
-                    elif st == STATUS_SKIP and prog.skip_message is not None:
-                        # foreach 'rule skipped' is a static message
-                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                          prog.skip_message, RuleStatus.SKIP)
-                    elif st == STATUS_FAIL and \
-                            (msg := self._fail_message_cached(
-                                prog, j, fdet[k])) is not None:
-                        # device-decided FAIL with a synthesizable message
-                        # (static message + fail-site path template)
-                        rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
-                                          msg, RuleStatus.FAIL)
-                    else:
-                        # anchor-SKIP / HOST / unsynthesizable FAIL: re-run
-                        # this rule on the host for the exact status+message
-                        rr = self._materialize(prog, res_doc)
-                        if rr is None:
-                            continue
-                    rr.timestamp = ts
-                    resp.policy_response.rules.append(rr)
-                    if rr.status in (RuleStatus.PASS, RuleStatus.FAIL):
-                        resp.policy_response.rules_applied_count += 1
-                    elif rr.status == RuleStatus.ERROR:
-                        resp.policy_response.rules_error_count += 1
+                        resp = self._new_response(p_idx, res_doc, now,
+                                                  wrapped[i])
+                        responses[p_idx] = resp
+                    if rr is None:
+                        continue
+                    pr = resp.policy_response
+                    pr.rules.append(rr)
+                    s = rr.status
+                    if s == RuleStatus.PASS or s == RuleStatus.FAIL:
+                        pr.rules_applied_count += 1
+                    elif s == RuleStatus.ERROR:
+                        pr.rules_error_count += 1
                 for p_idx in self._host_policy_idx:
                     if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
                         responses[p_idx] = self._host_run(p_idx, res_doc)
@@ -531,6 +549,32 @@ class BatchScanner:
                             p_idx, res_doc, now, wrapped[i])
                 out.append([responses[q] for q in sorted(responses)])
         return out
+
+    def _synth_rule(self, prog, st: int, det: int, ts: int):
+        """Build the shared (flyweight) RuleResponse for one device-
+        synthesizable non-FAIL (program, status, detail) cell, or the
+        _HOST_MARKER when the cell needs host materialization."""
+        if st == STATUS_PASS:
+            rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                              prog.pass_messages[det], RuleStatus.PASS)
+            if prog.pss is not None:
+                rr.pod_security_checks = {
+                    'level': prog.pss[0], 'version': prog.pss[1],
+                    'checks': []}
+        elif st == STATUS_SKIP_PRECOND:
+            rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                              PRECONDITIONS_SKIP_MESSAGE, RuleStatus.SKIP)
+        elif st == STATUS_VAR_ERR:
+            rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                              prog.error_messages[det], RuleStatus.ERROR)
+        elif st == STATUS_SKIP and prog.skip_message is not None:
+            # foreach 'rule skipped' is a static message
+            rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                              prog.skip_message, RuleStatus.SKIP)
+        else:
+            return _HOST_MARKER
+        rr.timestamp = ts
+        return rr
 
     def _host_policy_maybe(self, resources, wrapped):
         """Per host policy: bool[R] 'any rule may match', or None when the

@@ -38,14 +38,25 @@ _INTERPRETER = TreeInterpreter(_REGISTRY)
 
 
 class CompiledExpression:
-    __slots__ = ('expression', 'ast')
+    __slots__ = ('expression', 'ast', '_fn')
 
     def __init__(self, expression: str, ast: dict):
         self.expression = expression
         self.ast = ast
+        self._fn = None
 
     def search(self, data: Any) -> Any:
-        result = _INTERPRETER.visit(self.ast, data)
+        fn = self._fn
+        if fn is None:
+            # lower to closures on first use (closures.py); unsupported
+            # nodes fall back to the tree interpreter permanently
+            from .closures import UnsupportedNode, compile_closure
+            try:
+                fn = compile_closure(self.ast, _INTERPRETER)
+            except UnsupportedNode:
+                fn = lambda value: _INTERPRETER.visit(self.ast, value)  # noqa: E731
+            self._fn = fn
+        result = fn(data)
         if result is NOT_FOUND:
             raise NotFoundError(f'Unknown key "{self.expression}" in path')
         return result

@@ -45,34 +45,61 @@ def severity_from_string(severity: str) -> str:
     return ''
 
 
+# per-policy static template cache: the policy key / scored flag /
+# category / severity never vary between rules of one policy, and batch
+# scans map millions of rules — re-deriving them per rule dominates
+# report construction (keyed by id(); the tiny bound makes stale-id
+# reuse harmless since entries also store the policy for identity check)
+_POLICY_STATIC_CACHE: Dict[int, tuple] = {}
+
+
+def _policy_static(policy) -> dict:
+    pid = id(policy)
+    hit = _POLICY_STATIC_CACHE.get(pid)
+    if hit is not None and hit[0] is policy:
+        return hit[1]
+    annotations = policy.annotations if policy else {}
+    template = (
+        policy.get_kind_and_name() if policy else '',
+        annotations.get(ANNOTATION_POLICY_SCORED) != 'false',
+        annotations.get(ANNOTATION_POLICY_CATEGORY),
+        severity_from_string(
+            annotations.get(ANNOTATION_POLICY_SEVERITY, '')),
+    )
+    if len(_POLICY_STATIC_CACHE) > 4096:
+        _POLICY_STATIC_CACHE.clear()
+    _POLICY_STATIC_CACHE[pid] = (policy, template)
+    return template
+
+
 def engine_response_to_report_results(response: EngineResponse,
                                       now: Optional[int] = None
                                       ) -> List[dict]:
     """reference: results.go:84 EngineResponseToReportResults"""
     policy = response.policy
-    key = policy.get_kind_and_name() if policy else ''
-    annotations = policy.annotations if policy else {}
+    key, scored, category, severity = _policy_static(policy)
     if now is None:
         now = int(time.time())
+    ts = {'seconds': now}
     results = []
     for rule in response.policy_response.rules:
+        r = to_policy_result(rule.status)
+        if r == STATUS_FAIL and not scored:
+            r = STATUS_WARN
         result = {
             'source': 'kyverno',
             'policy': key,
             'rule': rule.name,
             'message': rule.message,
-            'result': to_policy_result(rule.status),
-            'scored': annotations.get(ANNOTATION_POLICY_SCORED) != 'false',
-            'timestamp': {'seconds': now},
+            'result': r,
+            'scored': scored,
+            'timestamp': ts,
         }
-        category = annotations.get(ANNOTATION_POLICY_CATEGORY)
         if category:
             result['category'] = category
-        severity = severity_from_string(
-            annotations.get(ANNOTATION_POLICY_SEVERITY, ''))
         if severity:
             result['severity'] = severity
-        checks = getattr(rule, 'pod_security_checks', None)
+        checks = rule.pod_security_checks
         if checks:
             controls = sorted(c['id'] for c in checks.get('checks', [])
                               if not c.get('allowed', True))
@@ -82,8 +109,6 @@ def engine_response_to_report_results(response: EngineResponse,
                     'version': checks.get('version', ''),
                     'controls': ','.join(controls),
                 }
-        if result['result'] == STATUS_FAIL and not result['scored']:
-            result['result'] = STATUS_WARN
         results.append(result)
     return results
 

@@ -296,6 +296,7 @@ class ResourceHandlers:
                     admission=(pctx.admission_info, pctx.exclude_group_roles,
                                pctx.namespace_labels, 'CREATE'),
                     pctx_factory=lambda doc: pctx)
+                self._device_failures = 0  # the limit counts consecutive
             except Exception as e:  # noqa: BLE001
                 # device failure must not turn into a 500: drop to the
                 # host engine loop and discard the broken scanner so the

@@ -203,13 +203,20 @@ class WebhookServer:
                             raise  # first load must succeed
 
             reload_if_rotated()
-            self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True)
+            # the listener stays plaintext; each accepted connection is
+            # wrapped AFTER the rotation check, so a pair rotated while
+            # the server sat idle in accept() is picked up by the very
+            # next connection.  The handshake is deferred to the handler
+            # thread (do_handshake_on_connect=False) so a slow client
+            # cannot stall the accept loop.
             inner_get_request = self._httpd.get_request
 
             def get_request():
+                sock, addr = inner_get_request()
                 reload_if_rotated()
-                return inner_get_request()
+                return (ctx.wrap_socket(sock, server_side=True,
+                                        do_handshake_on_connect=False),
+                        addr)
             self._httpd.get_request = get_request
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
