@@ -28,7 +28,7 @@ from ..engine.api import PolicyContext
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
 from .scan import _group_key, _rule_match_is_label_simple, \
-    _rule_match_is_simple
+    _rule_match_is_simple, policy_namespace_gate
 
 
 class ApplyResult:
@@ -94,14 +94,9 @@ class BatchApplier:
 
     # -- match sieve --------------------------------------------------------
 
-    def _gate(self, policy: Policy, res: Resource) -> bool:
-        if not policy.is_namespaced:
-            return True
-        return bool(res.namespace) and res.namespace == policy.namespace
-
     def _match_col(self, col: int, res: Resource) -> bool:
         pi, rule = self._cols[col]
-        if not self._gate(self.policies[pi], res):
+        if not policy_namespace_gate(self.policies[pi], res):
             return False
         return matches_resource_description(
             res, rule, None, [], {}, '') is None
@@ -170,19 +165,30 @@ class BatchApplier:
             return [self._apply_one(doc) for doc in resources]
         return self._apply_parallel(resources)
 
+    def _pool_executor(self):
+        """Lazily created, reused process pool (worker startup rebuilds
+        the engine per process — paying that per apply() call would
+        dominate small dumps)."""
+        if getattr(self, '_pool', None) is None:
+            import weakref
+            from concurrent.futures import ProcessPoolExecutor
+            pool = ProcessPoolExecutor(
+                max_workers=self.processes,
+                initializer=_worker_init,
+                initargs=([p.raw for p in self.policies],))
+            self._pool = pool
+            self._pool_finalizer = weakref.finalize(
+                self, pool.shutdown, wait=False, cancel_futures=True)
+        return self._pool
+
     def _apply_parallel(self, resources: List[dict]) -> List[ApplyResult]:
-        from concurrent.futures import ProcessPoolExecutor
-        docs = [p.raw for p in self.policies]
         chunk = max(256, len(resources) // (self.processes * 4))
         parts = [resources[i:i + chunk]
                  for i in range(0, len(resources), chunk)]
         try:
-            with ProcessPoolExecutor(
-                    max_workers=self.processes,
-                    initializer=_worker_init,
-                    initargs=(docs,)) as pool:
-                outs = list(pool.map(_worker_apply, parts))
+            outs = list(self._pool_executor().map(_worker_apply, parts))
         except Exception:  # noqa: BLE001 - pool loss degrades in-process
+            self._pool = None
             return [self._apply_one(doc) for doc in resources]
         results: List[ApplyResult] = []
         for part in outs:

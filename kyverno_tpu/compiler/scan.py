@@ -133,6 +133,15 @@ def _rule_match_is_label_simple(rule: dict) -> bool:
     return _rule_match_is_simple(rule, _LABEL_MATCH_KEYS)
 
 
+def policy_namespace_gate(policy: Policy, res: Resource) -> bool:
+    """Namespaced policies only apply inside their own namespace
+    (engine.py:230-236, reference: pkg/engine/validation.go:117).
+    Shared by the scan and bulk-apply match sieves."""
+    if not policy.is_namespaced:
+        return True
+    return bool(res.namespace) and res.namespace == policy.namespace
+
+
 def _group_key(doc: dict) -> Tuple[str, str, str]:
     meta = doc.get('metadata') or {}
     return (str(doc.get('kind', '')), str(doc.get('apiVersion', '')),
@@ -198,11 +207,7 @@ class BatchScanner:
     # -- match --------------------------------------------------------------
 
     def _policy_gate(self, policy: Policy, res: Resource) -> bool:
-        """Namespaced policies only apply inside their own namespace
-        (engine.py:230-236, reference: pkg/engine/validation.go:117)."""
-        if not policy.is_namespaced:
-            return True
-        return bool(res.namespace) and res.namespace == policy.namespace
+        return policy_namespace_gate(policy, res)
 
     def _match_one(self, j: int, res: Resource,
                    admission: Optional[tuple] = None) -> bool:

@@ -1139,6 +1139,25 @@ def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
 _PERSISTENT_CACHE_ON = False
 
 
+def _host_fingerprint() -> str:
+    """Short hash of the host CPU feature set.  XLA:CPU AOT artifacts
+    embed the compile machine's features and can SIGILL when loaded on a
+    host missing them; scoping the cache dir per feature set keeps a
+    shared checkout safe across heterogeneous machines."""
+    import hashlib
+    try:
+        with open('/proc/cpuinfo') as f:
+            for line in f:
+                if line.startswith('flags'):
+                    return hashlib.sha256(
+                        ' '.join(sorted(line.split())).encode()
+                    ).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(platform.machine().encode()).hexdigest()[:10]
+
+
 def enable_persistent_compilation_cache() -> Optional[str]:
     """Point XLA's persistent compilation cache at a disk directory so a
     fresh process re-serving the same policy set skips the (multi-second)
@@ -1149,7 +1168,8 @@ def enable_persistent_compilation_cache() -> Optional[str]:
     cache_dir = os.environ.get(
         'KTPU_COMPILE_CACHE',
         os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), '.cache', 'xla'))
+            os.path.dirname(os.path.abspath(__file__)))), '.cache',
+            f'xla-{_host_fingerprint()}'))
     if _PERSISTENT_CACHE_ON:
         return cache_dir
     try:

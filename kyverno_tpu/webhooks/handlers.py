@@ -222,6 +222,10 @@ class ResourceHandlers:
     # scanner rebuild; a persistently broken backend must not recompile
     # the policy set on every request)
     DEVICE_FAILURE_LIMIT = 3
+    # ceiling on simultaneous background scanner compiles (jax trace +
+    # XLA compile are memory-heavy; a burst across many policy sets
+    # serves the host loop rather than forking a compile per set)
+    MAX_CONCURRENT_BUILDS = 2
 
     def __init__(self, cache: 'pcache.Cache', engine: Optional[Engine] = None,
                  pc_builder: Optional[admission.PolicyContextBuilder] = None,
@@ -248,19 +252,90 @@ class ResourceHandlers:
         # the compiled device evaluator handles enforce validation for
         # CREATE requests; rebuilt when the cached policy set changes
         self.device = device
-        self._scanner = None
-        self._scanner_policies = None
         self._device_failures = 0
+        self._scanner_lock = threading.Lock()
+        # LRU of compiled scanners keyed per policy set: admission
+        # traffic alternating kinds/namespaces yields different policy
+        # lists and must not rebuild (compile!) per request
+        self._scanners: 'collections.OrderedDict[tuple, Any]' = \
+            collections.OrderedDict()
+        self._scanners_max = 8
+        self._building: set = set()
+
+    @staticmethod
+    def _policy_key(policies):
+        return tuple(id(p) for p in policies)
 
     def _device_scanner(self, policies):
-        if self._scanner_policies is not policies and \
-                (self._scanner_policies is None or
-                 [id(p) for p in self._scanner_policies] !=
-                 [id(p) for p in policies]):
-            from ..compiler.scan import BatchScanner
-            self._scanner = BatchScanner(policies, engine=self.engine)
-            self._scanner_policies = policies
-        return self._scanner
+        """Scanner for ``policies``, or None while one is still compiling.
+
+        Building a BatchScanner pays jax trace + XLA compile (seconds to
+        minutes on a policy-set change); doing that on the request path
+        would blow the webhook timeout (reference: 10s cap,
+        spec_types.go:95).  The build runs on a background thread and
+        requests serve the host engine loop — identical verdicts — until
+        the compiled path is ready."""
+        key = self._policy_key(policies)
+        with self._scanner_lock:
+            scanner = self._scanners.get(key)
+            if scanner is not None:
+                self._scanners.move_to_end(key)
+                return scanner
+            if key in self._building:
+                return None  # still compiling; host loop serves meanwhile
+            if len(self._building) >= self.MAX_CONCURRENT_BUILDS:
+                # a compile burst across many policy sets must not fork
+                # unbounded trace+compile threads; later requests retry
+                return None
+            self._building.add(key)
+
+        def build():
+            try:
+                from ..compiler.scan import BatchScanner
+                scanner = BatchScanner(policies, engine=self.engine)
+                # pre-warm the small-batch shapes an admission request
+                # hits (XLA compiles per shape bucket)
+                warm = {'apiVersion': 'v1', 'kind': 'Pod',
+                        'metadata': {'name': 'warm', 'namespace': 'default'},
+                        'spec': {'containers': [
+                            {'name': f'c{i}', 'image': 'warm:1'}
+                            for i in range(5)]}}
+                scanner.scan([warm])
+                with self._scanner_lock:
+                    while len(self._scanners) >= self._scanners_max:
+                        self._scanners.popitem(last=False)
+                    self._scanners[key] = scanner
+            except Exception as e:  # noqa: BLE001
+                # a policy set that cannot compile must trip the same
+                # circuit breaker the request-path failures do, or every
+                # request re-spawns a doomed multi-second compile
+                self._device_failures += 1
+                import logging
+                from ..observability.logging import with_values
+                log = logging.getLogger('kyverno.webhooks')
+                with_values(log, 'device scanner build failed',
+                            level=logging.ERROR, error=str(e),
+                            failures=self._device_failures)
+                if self._device_failures >= self.DEVICE_FAILURE_LIMIT:
+                    with_values(log, 'device path disabled after repeated '
+                                'failures', level=logging.ERROR)
+                    self.device = False
+            finally:
+                with self._scanner_lock:
+                    self._building.discard(key)
+        threading.Thread(target=build, name='ktpu-scanner-build',
+                         daemon=True).start()
+        return None
+
+    def wait_device_ready(self, policies, timeout: float = 600.0) -> bool:
+        """Block until the compiled scanner for ``policies`` is serving
+        (benchmarks / tests measuring steady-state latency)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._device_scanner(policies) is not None:
+                return True
+            time.sleep(0.05)
+        return False
 
     # -- validate ---------------------------------------------------------
 
@@ -289,14 +364,19 @@ class ResourceHandlers:
         if use_device:
             try:
                 scanner = self._device_scanner(policies)
-                resource = admission.request_resource(request)
-                [responses] = scanner.scan(
-                    [resource],
-                    contexts=[pctx.json_context._data],
-                    admission=(pctx.admission_info, pctx.exclude_group_roles,
-                               pctx.namespace_labels, 'CREATE'),
-                    pctx_factory=lambda doc: pctx)
-                self._device_failures = 0  # the limit counts consecutive
+                if scanner is None:
+                    # compiled path still building: host loop this request
+                    use_device = False
+                else:
+                    resource = admission.request_resource(request)
+                    [responses] = scanner.scan(
+                        [resource],
+                        contexts=[pctx.json_context._data],
+                        admission=(pctx.admission_info,
+                                   pctx.exclude_group_roles,
+                                   pctx.namespace_labels, 'CREATE'),
+                        pctx_factory=lambda doc: pctx)
+                    self._device_failures = 0  # limit counts consecutive
             except Exception as e:  # noqa: BLE001
                 # device failure must not turn into a 500: drop to the
                 # host engine loop and discard the broken scanner so the
@@ -304,8 +384,8 @@ class ResourceHandlers:
                 # Repeated failures disable the device path entirely —
                 # otherwise every request would pay a full policy-set
                 # recompile before falling back.
-                self._scanner = None
-                self._scanner_policies = None
+                with self._scanner_lock:
+                    self._scanners.pop(self._policy_key(policies), None)
                 self._device_failures += 1
                 import logging
                 from ..observability.logging import with_values

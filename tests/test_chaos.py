@@ -151,17 +151,20 @@ def test_device_crash_falls_back_to_host_engine():
     class Bomb:
         def scan(self, *a, **k):
             raise RuntimeError('injected XLA device failure')
-    handlers._scanner = Bomb()
-    handlers._scanner_policies = handlers.cache.get_policies(
-        'validate/enforce', 'Pod', 'default')
-    # force the cached-scanner path to hand out the bomb
-    handlers._device_scanner = lambda policies: handlers._scanner or Bomb()
+    from kyverno_tpu.policycache.cache import VALIDATE_ENFORCE
+    policies = handlers.cache.get_policies(
+        VALIDATE_ENFORCE, 'Pod', 'default')
+    assert policies
+    key = handlers._policy_key(policies)
+    handlers._scanners[key] = Bomb()
 
     server = WebhookServer(handlers)
     out = server.handle('/validate/fail', review_body(0, labeled=False))
     assert not allowed(out)          # fail-closed verdict from host engine
     out = server.handle('/validate/fail', review_body(1, labeled=True))
     assert allowed(out)
+    # the broken scanner was evicted so a healthy rebuild can replace it
+    assert not isinstance(handlers._scanners.get(key), Bomb)
 
 
 # ---------------------------------------------------------------------------
