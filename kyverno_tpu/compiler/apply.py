@@ -188,6 +188,12 @@ class BatchApplier:
         try:
             outs = list(self._pool_executor().map(_worker_apply, parts))
         except Exception:  # noqa: BLE001 - pool loss degrades in-process
+            # shut the workers down before dropping the reference — the
+            # failure may be a bad input rather than pool death, and
+            # orphaned workers would stack up across incidents
+            fin = getattr(self, '_pool_finalizer', None)
+            if fin is not None:
+                fin()
             self._pool = None
             return [self._apply_one(doc) for doc in resources]
         results: List[ApplyResult] = []

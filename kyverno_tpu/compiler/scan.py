@@ -173,6 +173,9 @@ class BatchScanner:
         self.device_programs: List[Tuple[int, RuleProgram]] = [
             (j, prog) for j, prog in enumerate(self.cps.programs)
             if prog.policy_index not in host_set]
+        self._dev_mask = np.zeros(len(self.cps.programs), bool)
+        for _j, _ in self.device_programs:
+            self._dev_mask[_j] = True
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
         from collections import OrderedDict
@@ -458,11 +461,13 @@ class BatchScanner:
         ts = int(now)
 
         # which host policies could match each resource at all (group
-        # screen over their simple rules; non-simple rules force a run);
-        # admission scans always run host policies (operation-sensitive)
-        host_maybe = self._host_policy_maybe(resources, wrapped) \
-            if background_mode else \
-            {p: None for p in self._host_policy_idx}
+        # screen over their simple rules; non-simple rules force a run).
+        # The screen is valid for admission scans too: simple-match
+        # rules only reference kinds/namespaces (the matcher ignores
+        # operations entirely, and roles/subjects rules are non-simple),
+        # and a screened-out policy contributes the same empty response
+        # the engine would produce.
+        host_maybe = self._host_policy_maybe(resources, wrapped)
 
         progs = self.cps.programs
         background_ok = np.array([
@@ -471,11 +476,14 @@ class BatchScanner:
         out: List[List[EngineResponse]] = []
         # the device chunks stream through while this loop assembles —
         # three pipeline stages (encode / device / assemble) overlap.
-        # Assembly is column-wise (per program over the whole chunk):
-        # the status branch, message lookup and int casts amortize over
-        # all rows of a column, and identical device-synthesized cells
-        # share one flyweight RuleResponse (treat rule responses from
-        # scan() as immutable — every downstream consumer only reads).
+        # Large chunks assemble column-wise (per program over the whole
+        # chunk): the status branch, message lookup and int casts
+        # amortize over all rows of a column.  Small batches (admission:
+        # one resource) assemble row-wise — a column sweep would pay one
+        # numpy call per program for a single resource.  Identical
+        # device-synthesized cells share one flyweight RuleResponse
+        # (treat rule responses from scan() as immutable — every
+        # downstream consumer only reads).
         _HOST = _HOST_MARKER
         for start, status, detail, fdet in \
                 self._device_status_chunks(resources, contexts):
@@ -483,50 +491,52 @@ class BatchScanner:
             sub_match = match[start:start + m]
             # per-row [(policy_index, RuleResponse|None), ...] in j order
             acc: List[list] = [[] for _ in range(m)]
-            for j, prog in self.device_programs:
-                rows = np.flatnonzero(sub_match[:, j])
-                if rows.size == 0:
-                    continue
-                p_idx = prog.policy_index
-                if background_mode and not background_ok[j]:
-                    # background-disabled policies contribute an empty
-                    # response (engine.py:174 apply_background_checks)
-                    for k in rows.tolist():
-                        acc[k].append((p_idx, None))
-                    continue
-                st_col = status[rows, j].tolist()
-                det_col = detail[rows, j].tolist()
-                flyweights: Dict[Tuple, Any] = {}
-                for k, st, det in zip(rows.tolist(), st_col, det_col):
-                    if st == STATUS_FAIL:
-                        # the fail-site detail row carries anyPattern
-                        # metadata beyond column j — _fail_message_cached
-                        # is itself memoized on the relevant columns
-                        msg = self._fail_message_cached(prog, j, fdet[k])
-                        if msg is None:
-                            rr = _HOST
-                        else:
-                            rr = flyweights.get(msg)
-                            if rr is None:
-                                rr = RuleResponse(prog.rule_name,
-                                                  RuleType.VALIDATION,
-                                                  msg, RuleStatus.FAIL)
+            fly: Dict[Tuple, Any] = {}
+            if m <= self.SMALL_BATCH:
+                for k in range(m):
+                    row_js = np.flatnonzero(sub_match[k] & self._dev_mask)
+                    st_row = status[k]
+                    det_row = detail[k]
+                    for j in row_js.tolist():
+                        prog = progs[j]
+                        if background_mode and not background_ok[j]:
+                            acc[k].append((prog.policy_index, None))
+                            continue
+                        rr = self._cell(prog, j, int(st_row[j]),
+                                        int(det_row[j]), fdet[k], ts, fly)
+                        if rr is _HOST:
+                            rr = self._materialize(prog,
+                                                   resources[start + k])
+                            if rr is not None:
                                 rr.timestamp = ts
-                                flyweights[msg] = rr
-                    else:
-                        key = (st, det)
-                        rr = flyweights.get(key)
-                        if rr is None:
-                            rr = self._synth_rule(prog, st, det, ts)
-                            flyweights[key] = rr
-                    if rr is _HOST:
-                        # anchor-SKIP / HOST / unsynthesizable FAIL:
-                        # re-run on the host for exact status+message
-                        rr = self._materialize(prog, resources[start + k])
-                        if rr is not None:
-                            rr.timestamp = ts
-                    acc[k].append((p_idx, None if rr is None or
-                                   rr is _HOST else rr))
+                        acc[k].append((prog.policy_index,
+                                       None if rr is None or rr is _HOST
+                                       else rr))
+            else:
+                for j, prog in self.device_programs:
+                    rows = np.flatnonzero(sub_match[:, j])
+                    if rows.size == 0:
+                        continue
+                    p_idx = prog.policy_index
+                    if background_mode and not background_ok[j]:
+                        # background-disabled policies contribute an empty
+                        # response (engine.py:174 apply_background_checks)
+                        for k in rows.tolist():
+                            acc[k].append((p_idx, None))
+                        continue
+                    st_col = status[rows, j].tolist()
+                    det_col = detail[rows, j].tolist()
+                    for k, st, det in zip(rows.tolist(), st_col, det_col):
+                        rr = self._cell(prog, j, st, det, fdet[k], ts, fly)
+                        if rr is _HOST:
+                            # anchor-SKIP / HOST / unsynthesizable FAIL:
+                            # re-run on the host for exact status+message
+                            rr = self._materialize(prog,
+                                                   resources[start + k])
+                            if rr is not None:
+                                rr.timestamp = ts
+                        acc[k].append((p_idx, None if rr is None or
+                                       rr is _HOST else rr))
             for k in range(m):
                 i = start + k
                 res_doc = resources[i]
@@ -555,6 +565,33 @@ class BatchScanner:
                 out.append([responses[q] for q in sorted(responses)])
         return out
 
+    def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
+              fly: Dict[Tuple, Any]):
+        """Flyweight RuleResponse for one device cell (or _HOST_MARKER).
+
+        FAIL cells key on the synthesized message — the fail-site detail
+        row carries anyPattern metadata beyond column j and
+        ``_fail_message_cached`` is itself memoized on the relevant
+        columns."""
+        if st == STATUS_FAIL:
+            msg = self._fail_message_cached(prog, j, fdet_row)
+            if msg is None:
+                return _HOST_MARKER
+            key = (j, STATUS_FAIL, msg)
+            rr = fly.get(key)
+            if rr is None:
+                rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                  msg, RuleStatus.FAIL)
+                rr.timestamp = ts
+                fly[key] = rr
+            return rr
+        key = (j, st, det)
+        rr = fly.get(key)
+        if rr is None:
+            rr = self._synth_rule(prog, st, det, ts)
+            fly[key] = rr
+        return rr
+
     def _synth_rule(self, prog, st: int, det: int, ts: int):
         """Build the shared (flyweight) RuleResponse for one device-
         synthesizable non-FAIL (program, status, detail) cell, or the
@@ -581,21 +618,37 @@ class BatchScanner:
         rr.timestamp = ts
         return rr
 
+    def _host_policy_rules(self):
+        """Per host policy: its autogen-expanded Rule objects when every
+        rule is simple-match, else None (always run).  Autogen expansion
+        deep-copies rule trees, so computing it per scan call dominated
+        single-request admission latency — the policy set is immutable
+        for a scanner's lifetime, compute once."""
+        cached = getattr(self, '_host_rules_cache', None)
+        if cached is None:
+            from ..autogen.autogen import compute_rules
+            cached = {}
+            for p_idx in self._host_policy_idx:
+                rules = compute_rules(self.policies[p_idx])
+                cached[p_idx] = [Rule(r) for r in rules] \
+                    if all(_rule_match_is_simple(r) for r in rules) else None
+            self._host_rules_cache = cached
+        return cached
+
     def _host_policy_maybe(self, resources, wrapped):
         """Per host policy: bool[R] 'any rule may match', or None when the
         policy has non-simple rules (always run)."""
-        from ..autogen.autogen import compute_rules
         maybe: Dict[int, Optional[np.ndarray]] = {}
         group_of = [_group_key(doc) for doc in resources]
+        host_rules = self._host_policy_rules()
         for p_idx in self._host_policy_idx:
             policy = self.policies[p_idx]
-            rules = compute_rules(policy)
-            if not all(_rule_match_is_simple(r) for r in rules):
+            robj = host_rules[p_idx]
+            if robj is None:
                 maybe[p_idx] = None
                 continue
             cache: Dict[Tuple, bool] = {}
             flags = np.zeros(len(resources), bool)
-            robj = [Rule(r) for r in rules]
             for i, key in enumerate(group_of):
                 hit = cache.get(key)
                 if hit is None:

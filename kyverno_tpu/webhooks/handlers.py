@@ -252,7 +252,6 @@ class ResourceHandlers:
         # the compiled device evaluator handles enforce validation for
         # CREATE requests; rebuilt when the cached policy set changes
         self.device = device
-        self._device_failures = 0
         self._scanner_lock = threading.Lock()
         # LRU of compiled scanners keyed per policy set: admission
         # traffic alternating kinds/namespaces yields different policy
@@ -261,6 +260,12 @@ class ResourceHandlers:
             collections.OrderedDict()
         self._scanners_max = 8
         self._building: set = set()
+        # per-policy-set consecutive failure counts (build or scan); a
+        # set that keeps failing goes to _dead_keys and serves the host
+        # loop permanently — per key, so one broken set cannot disable
+        # (nor have its counter reset by) a healthy one
+        self._key_failures: Dict[tuple, int] = {}
+        self._dead_keys: set = set()
 
     @staticmethod
     def _policy_key(policies):
@@ -281,6 +286,8 @@ class ResourceHandlers:
             if scanner is not None:
                 self._scanners.move_to_end(key)
                 return scanner
+            if key in self._dead_keys:
+                return None  # circuit broken: host loop, no more builds
             if key in self._building:
                 return None  # still compiling; host loop serves meanwhile
             if len(self._building) >= self.MAX_CONCURRENT_BUILDS:
@@ -306,20 +313,10 @@ class ResourceHandlers:
                         self._scanners.popitem(last=False)
                     self._scanners[key] = scanner
             except Exception as e:  # noqa: BLE001
-                # a policy set that cannot compile must trip the same
-                # circuit breaker the request-path failures do, or every
-                # request re-spawns a doomed multi-second compile
-                self._device_failures += 1
-                import logging
-                from ..observability.logging import with_values
-                log = logging.getLogger('kyverno.webhooks')
-                with_values(log, 'device scanner build failed',
-                            level=logging.ERROR, error=str(e),
-                            failures=self._device_failures)
-                if self._device_failures >= self.DEVICE_FAILURE_LIMIT:
-                    with_values(log, 'device path disabled after repeated '
-                                'failures', level=logging.ERROR)
-                    self.device = False
+                # a policy set that cannot compile must trip the circuit
+                # breaker, or every request re-spawns a doomed
+                # multi-second compile
+                self._record_key_failure(key, f'build failed: {e}')
             finally:
                 with self._scanner_lock:
                     self._building.discard(key)
@@ -327,11 +324,33 @@ class ResourceHandlers:
                          daemon=True).start()
         return None
 
+    def _record_key_failure(self, key: tuple, reason: str) -> None:
+        import logging
+        from ..observability.logging import with_values
+        log = logging.getLogger('kyverno.webhooks')
+        with self._scanner_lock:
+            self._key_failures[key] = self._key_failures.get(key, 0) + 1
+            n = self._key_failures[key]
+            if n >= self.DEVICE_FAILURE_LIMIT:
+                self._dead_keys.add(key)
+        with_values(log, 'device path failure', level=logging.ERROR,
+                    error=reason, failures=n)
+        if n >= self.DEVICE_FAILURE_LIMIT:
+            with_values(log, 'device path disabled for this policy set '
+                        'after repeated failures', level=logging.ERROR)
+
     def wait_device_ready(self, policies, timeout: float = 600.0) -> bool:
         """Block until the compiled scanner for ``policies`` is serving
-        (benchmarks / tests measuring steady-state latency)."""
+        (benchmarks / tests measuring steady-state latency).  Returns
+        False immediately once the set's circuit breaker has tripped."""
+        key = self._policy_key(policies)
         deadline = time.time() + timeout
         while time.time() < deadline:
+            if not self.device:
+                return False
+            with self._scanner_lock:
+                if key in self._dead_keys:
+                    return False
             if self._device_scanner(policies) is not None:
                 return True
             time.sleep(0.05)
@@ -376,27 +395,22 @@ class ResourceHandlers:
                                    pctx.exclude_group_roles,
                                    pctx.namespace_labels, 'CREATE'),
                         pctx_factory=lambda doc: pctx)
-                    self._device_failures = 0  # limit counts consecutive
+                    with self._scanner_lock:
+                        # the limit counts consecutive failures per set
+                        self._key_failures.pop(
+                            self._policy_key(policies), None)
             except Exception as e:  # noqa: BLE001
                 # device failure must not turn into a 500: drop to the
                 # host engine loop and discard the broken scanner so the
                 # next request rebuilds it (failure recovery, SURVEY §5.3).
-                # Repeated failures disable the device path entirely —
+                # Repeated failures trip the per-set circuit breaker —
                 # otherwise every request would pay a full policy-set
                 # recompile before falling back.
+                key = self._policy_key(policies)
                 with self._scanner_lock:
-                    self._scanners.pop(self._policy_key(policies), None)
-                self._device_failures += 1
-                import logging
-                from ..observability.logging import with_values
-                log = logging.getLogger('kyverno.webhooks')
-                with_values(log, 'device scan failed, falling back to '
-                            'host engine', level=logging.ERROR,
-                            error=str(e), failures=self._device_failures)
-                if self._device_failures >= self.DEVICE_FAILURE_LIMIT:
-                    with_values(log, 'device path disabled after repeated '
-                                'failures', level=logging.ERROR)
-                    self.device = False
+                    self._scanners.pop(key, None)
+                self._record_key_failure(
+                    key, f'scan failed, falling back to host engine: {e}')
                 use_device = False
                 responses = []
         if not use_device:

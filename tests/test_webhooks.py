@@ -344,3 +344,64 @@ spec:
                     (username, labels)
                 assert dev.get('warnings') == host.get('warnings'), \
                     (username, labels)
+
+
+HOST_ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: host-require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  applyRules: One
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+
+class TestHostPolicyAdmissionScreen:
+    def test_host_policy_enforced_on_device_admission_path(self):
+        """A host-evaluated enforce policy (applyRules One keeps the
+        whole policy on the host engine) must still deny through the
+        device admission path — the host-policy pre-screen may only
+        skip sets that genuinely cannot match (regression: the screen
+        once passed the operation string as the matcher's
+        policy_namespace argument, silently screening every host
+        policy out of admission and admitting violations)."""
+        import json as _json
+        from kyverno_tpu.policycache.cache import VALIDATE_ENFORCE
+        cache = make_cache(HOST_ENFORCE_POLICY)
+        handlers = ResourceHandlers(cache, device=True)
+        server = WebhookServer(handlers)
+        assert handlers.wait_device_ready(cache.get_policies(
+            VALIDATE_ENFORCE, 'Pod', 'default'))
+
+        def review(labeled):
+            doc = {'apiVersion': 'v1', 'kind': 'Pod',
+                   'metadata': {'name': 'p', 'namespace': 'default',
+                                'labels': {'team': 'sre'} if labeled
+                                else {}},
+                   'spec': {'containers': [{'name': 'c',
+                                            'image': 'nginx:1'}]}}
+            return _json.dumps({
+                'apiVersion': 'admission.k8s.io/v1',
+                'kind': 'AdmissionReview',
+                'request': {'uid': 'u', 'operation': 'CREATE',
+                            'kind': {'group': '', 'version': 'v1',
+                                     'kind': 'Pod'},
+                            'namespace': 'default', 'name': 'p',
+                            'object': doc,
+                            'userInfo': {'username': 't'}}}).encode()
+        out = _json.loads(server.handle('/validate/fail', review(False)))
+        assert out['response']['allowed'] is False
+        assert 'team' in out['response']['status']['message']
+        out = _json.loads(server.handle('/validate/fail', review(True)))
+        assert out['response']['allowed'] is True
