@@ -20,11 +20,30 @@ LABEL_APP_MANAGED_BY = 'app.kubernetes.io/managed-by'
 VALUE_KYVERNO_APP = 'kyverno'
 
 
+# (policy id) → (policy, label, resourceVersion): label derivation runs
+# per (report, policy) pair during batch scans — millions of calls for
+# a value that is constant per policy object
+_POLICY_LABEL_CACHE: dict = {}
+
+
 def policy_label(policy: Policy) -> str:
     """reference: labels.go:61 PolicyLabel"""
+    return _policy_label_rv(policy)[0]
+
+
+def _policy_label_rv(policy: Policy):
+    pid = id(policy)
+    hit = _POLICY_LABEL_CACHE.get(pid)
+    if hit is not None and hit[0] is policy:
+        return hit[1], hit[2]
     domain = LABEL_DOMAIN_POLICY if policy.is_namespaced \
         else LABEL_DOMAIN_CLUSTER_POLICY
-    return f'{domain}/{policy.name}'
+    label = f'{domain}/{policy.name}'
+    rv = policy.metadata.get('resourceVersion', '') or ''
+    if len(_POLICY_LABEL_CACHE) > 4096:
+        _POLICY_LABEL_CACHE.clear()
+    _POLICY_LABEL_CACHE[pid] = (policy, label, rv)
+    return label, rv
 
 
 def is_policy_label(label: str) -> bool:
@@ -56,8 +75,8 @@ def set_managed_by_kyverno_label(obj: dict) -> None:
 def set_policy_label(report: dict, policy: Policy) -> None:
     """reference: labels.go:100 SetPolicyLabel — value is the policy's
     resourceVersion so report controllers detect stale results."""
-    _set_label(report, policy_label(policy),
-               policy.metadata.get('resourceVersion', '') or '')
+    label, rv = _policy_label_rv(policy)
+    _set_label(report, label, rv)
 
 
 def set_resource_labels(report: dict, uid: str) -> None:

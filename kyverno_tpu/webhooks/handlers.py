@@ -226,6 +226,9 @@ class ResourceHandlers:
     # XLA compile are memory-heavy; a burst across many policy sets
     # serves the host loop rather than forking a compile per set)
     MAX_CONCURRENT_BUILDS = 2
+    # distinct policy sets whose breaker tripped before the failure is
+    # treated as systemic and the device path disables globally
+    GLOBAL_DEAD_LIMIT = 3
 
     def __init__(self, cache: 'pcache.Cache', engine: Optional[Engine] = None,
                  pc_builder: Optional[admission.PolicyContextBuilder] = None,
@@ -263,9 +266,18 @@ class ResourceHandlers:
         # per-policy-set consecutive failure counts (build or scan); a
         # set that keeps failing goes to _dead_keys and serves the host
         # loop permanently — per key, so one broken set cannot disable
-        # (nor have its counter reset by) a healthy one
-        self._key_failures: Dict[tuple, int] = {}
-        self._dead_keys: set = set()
+        # (nor have its counter reset by) a healthy one.  Both maps pin
+        # the policy objects (keys are id() tuples — a dead key must
+        # not outlive its policies, or CPython id reuse could silently
+        # circuit-break a healthy set) and are size-bounded.  When
+        # several distinct sets die the failure is systemic (broken
+        # backend): the global device switch turns off so policy churn
+        # cannot spawn an endless stream of doomed compiles.
+        self._key_failures: 'collections.OrderedDict[tuple, list]' = \
+            collections.OrderedDict()
+        self._dead_keys: 'collections.OrderedDict[tuple, Any]' = \
+            collections.OrderedDict()
+        self._breaker_cap = 64
 
     @staticmethod
     def _policy_key(policies):
@@ -300,13 +312,16 @@ class ResourceHandlers:
             try:
                 from ..compiler.scan import BatchScanner
                 scanner = BatchScanner(policies, engine=self.engine)
-                # pre-warm the small-batch shapes an admission request
-                # hits (XLA compiles per shape bucket)
+                # pre-warm the small-batch shape an admission request
+                # hits: XLA compiles per shape bucket, and the element
+                # axis clamps to a minimum of 4 — a ≤4-container warm
+                # pod covers every ≤4-container request (the common
+                # case); larger pods lazily compile their bucket once
                 warm = {'apiVersion': 'v1', 'kind': 'Pod',
                         'metadata': {'name': 'warm', 'namespace': 'default'},
                         'spec': {'containers': [
                             {'name': f'c{i}', 'image': 'warm:1'}
-                            for i in range(5)]}}
+                            for i in range(2)]}}
                 scanner.scan([warm])
                 with self._scanner_lock:
                     while len(self._scanners) >= self._scanners_max:
@@ -316,7 +331,8 @@ class ResourceHandlers:
                 # a policy set that cannot compile must trip the circuit
                 # breaker, or every request re-spawns a doomed
                 # multi-second compile
-                self._record_key_failure(key, f'build failed: {e}')
+                self._record_key_failure(key, policies,
+                                         f'build failed: {e}')
             finally:
                 with self._scanner_lock:
                     self._building.discard(key)
@@ -324,20 +340,37 @@ class ResourceHandlers:
                          daemon=True).start()
         return None
 
-    def _record_key_failure(self, key: tuple, reason: str) -> None:
+    def _record_key_failure(self, key: tuple, policies, reason: str) -> None:
         import logging
         from ..observability.logging import with_values
         log = logging.getLogger('kyverno.webhooks')
+        systemic = False
         with self._scanner_lock:
-            self._key_failures[key] = self._key_failures.get(key, 0) + 1
-            n = self._key_failures[key]
+            entry = self._key_failures.get(key)
+            if entry is None:
+                entry = [0, list(policies)]  # pin ids while counted
+                while len(self._key_failures) >= self._breaker_cap:
+                    self._key_failures.popitem(last=False)
+                self._key_failures[key] = entry
+            entry[0] += 1
+            n = entry[0]
             if n >= self.DEVICE_FAILURE_LIMIT:
-                self._dead_keys.add(key)
+                while len(self._dead_keys) >= self._breaker_cap:
+                    self._dead_keys.popitem(last=False)
+                self._dead_keys[key] = entry[1]  # pin ids while dead
+                self._key_failures.pop(key, None)
+                if len(self._dead_keys) >= self.GLOBAL_DEAD_LIMIT:
+                    systemic = True
+                    self.device = False
         with_values(log, 'device path failure', level=logging.ERROR,
                     error=reason, failures=n)
         if n >= self.DEVICE_FAILURE_LIMIT:
             with_values(log, 'device path disabled for this policy set '
                         'after repeated failures', level=logging.ERROR)
+        if systemic:
+            with_values(log, 'device path disabled globally: multiple '
+                        'policy sets failing (systemic backend failure)',
+                        level=logging.ERROR)
 
     def wait_device_ready(self, policies, timeout: float = 600.0) -> bool:
         """Block until the compiled scanner for ``policies`` is serving
@@ -410,7 +443,8 @@ class ResourceHandlers:
                 with self._scanner_lock:
                     self._scanners.pop(key, None)
                 self._record_key_failure(
-                    key, f'scan failed, falling back to host engine: {e}')
+                    key, policies,
+                    f'scan failed, falling back to host engine: {e}')
                 use_device = False
                 responses = []
         if not use_device:

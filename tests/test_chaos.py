@@ -368,3 +368,28 @@ def test_scan_pipeline_survives_executor_loss():
 
 if __name__ == '__main__':
     sys.exit(pytest.main([__file__, '-q']))
+
+
+# ---------------------------------------------------------------------------
+# 9. systemic device failure: multiple dead policy sets disable globally
+
+def test_systemic_device_failure_disables_globally():
+    policy_yamls = [ENFORCE_POLICY.replace('require-team', f'set-{i}')
+                    for i in range(3)]
+    cache = make_cache(*policy_yamls)
+    handlers = ResourceHandlers(cache, device=True)
+    # three distinct policy sets, each failing past the per-set limit
+    for i in range(3):
+        from kyverno_tpu.api.policy import Policy
+        policies = [Policy(d) for d in yaml.safe_load_all(policy_yamls[i])]
+        key = handlers._policy_key(policies)
+        for _ in range(handlers.DEVICE_FAILURE_LIMIT):
+            handlers._record_key_failure(key, policies, 'injected')
+        assert key in handlers._dead_keys
+    assert handlers.device is False   # systemic: no more doomed compiles
+    # admission still serves correct verdicts via the host loop
+    server = WebhookServer(handlers)
+    assert not allowed(server.handle('/validate/fail',
+                                     review_body(0, labeled=False)))
+    assert allowed(server.handle('/validate/fail',
+                                 review_body(1, labeled=True)))
