@@ -439,14 +439,21 @@ class BatchScanner:
         namespace_labels, operation) for match semantics, and
         ``pctx_factory(doc)`` so host materialization sees the same
         PolicyContext the engine loop would build."""
-        n = len(resources)
-        if n == 0:
-            return []
-        from ..observability import tracing
-        with tracing.start_span(
-                'kyverno/device/scan',
-                {'resources': n, 'programs': len(self.cps.programs)}):
-            return self._scan_inner(resources, contexts, admission,
+        return list(self.scan_stream(resources, contexts, admission,
+                                     pctx_factory))
+
+    def scan_stream(self, resources: List[dict],
+                    contexts: Optional[List[dict]] = None,
+                    admission: Optional[tuple] = None,
+                    pctx_factory=None):
+        """Generator form of ``scan``: yields each resource's responses
+        in order as its device chunk completes.  Consumers that do
+        per-resource work (report construction, CR writes) overlap it
+        with the next chunk's encode/transfer/device stages instead of
+        paying it serially after the whole scan."""
+        if not resources:
+            return
+        yield from self._scan_inner(resources, contexts, admission,
                                     pctx_factory)
 
     def _scan_inner(self, resources, contexts, admission, pctx_factory):
@@ -473,7 +480,6 @@ class BatchScanner:
         background_ok = np.array([
             self.policies[p.policy_index].background for p in progs])
 
-        out: List[List[EngineResponse]] = []
         # the device chunks stream through while this loop assembles —
         # three pipeline stages (encode / device / assemble) overlap.
         # Large chunks assemble column-wise (per program over the whole
@@ -484,86 +490,118 @@ class BatchScanner:
         # device-synthesized cells share one flyweight RuleResponse
         # (treat rule responses from scan() as immutable — every
         # downstream consumer only reads).
-        _HOST = _HOST_MARKER
+        from ..observability import tracing
         for start, status, detail, fdet in \
                 self._device_status_chunks(resources, contexts):
-            m = status.shape[0]
-            sub_match = match[start:start + m]
-            # per-row [(policy_index, RuleResponse|None), ...] in j order
-            acc: List[list] = [[] for _ in range(m)]
-            fly: Dict[Tuple, Any] = {}
-            if m <= self.SMALL_BATCH:
-                for k in range(m):
-                    row_js = np.flatnonzero(sub_match[k] & self._dev_mask)
-                    st_row = status[k]
-                    det_row = detail[k]
-                    for j in row_js.tolist():
-                        prog = progs[j]
-                        if background_mode and not background_ok[j]:
-                            acc[k].append((prog.policy_index, None))
-                            continue
-                        rr = self._cell(prog, j, int(st_row[j]),
-                                        int(det_row[j]), fdet[k], ts, fly)
-                        if rr is _HOST:
-                            rr = self._materialize(prog,
-                                                   resources[start + k])
-                            if rr is not None:
-                                rr.timestamp = ts
-                        acc[k].append((prog.policy_index,
-                                       None if rr is None or rr is _HOST
-                                       else rr))
-            else:
-                for j, prog in self.device_programs:
-                    rows = np.flatnonzero(sub_match[:, j])
-                    if rows.size == 0:
-                        continue
-                    p_idx = prog.policy_index
-                    if background_mode and not background_ok[j]:
-                        # background-disabled policies contribute an empty
-                        # response (engine.py:174 apply_background_checks)
-                        for k in rows.tolist():
-                            acc[k].append((p_idx, None))
-                        continue
-                    st_col = status[rows, j].tolist()
-                    det_col = detail[rows, j].tolist()
-                    for k, st, det in zip(rows.tolist(), st_col, det_col):
-                        rr = self._cell(prog, j, st, det, fdet[k], ts, fly)
-                        if rr is _HOST:
-                            # anchor-SKIP / HOST / unsynthesizable FAIL:
-                            # re-run on the host for exact status+message
-                            rr = self._materialize(prog,
-                                                   resources[start + k])
-                            if rr is not None:
-                                rr.timestamp = ts
-                        acc[k].append((p_idx, None if rr is None or
-                                       rr is _HOST else rr))
+            # the span opens and closes within this single generator
+            # step (no yield inside the with-block): holding one span
+            # across yields would leak the current-span contextvar into
+            # the consumer and record a bogus error when the consumer
+            # stops iterating early
+            with tracing.start_span(
+                    'kyverno/device/scan',
+                    {'chunk_start': start, 'resources': status.shape[0],
+                     'programs': len(progs)}):
+                chunk_rows = self._assemble_chunk(
+                    resources, wrapped, match, start, status, detail,
+                    fdet, now, ts, background_mode, background_ok,
+                    host_maybe)
+            yield from chunk_rows
+
+    def _assemble_chunk(self, resources, wrapped, match, start, status,
+                        detail, fdet, now, ts, background_mode,
+                        background_ok, host_maybe
+                        ) -> List[List[EngineResponse]]:
+        """Assemble one device chunk into per-resource engine responses.
+
+        Large chunks assemble column-wise (per program over the whole
+        chunk): the status branch, message lookup and int casts
+        amortize over all rows of a column.  Small batches (admission:
+        one resource) assemble row-wise — a column sweep would pay one
+        numpy call per program for a single resource.  Identical
+        device-synthesized cells share one flyweight RuleResponse
+        (treat rule responses from scan() as immutable — every
+        downstream consumer only reads)."""
+        _HOST = _HOST_MARKER
+        progs = self.cps.programs
+        m = status.shape[0]
+        sub_match = match[start:start + m]
+        # per-row [(policy_index, RuleResponse|None), ...] in j order
+        acc: List[list] = [[] for _ in range(m)]
+        fly: Dict[Tuple, Any] = {}
+        if m <= self.SMALL_BATCH:
             for k in range(m):
-                i = start + k
-                res_doc = resources[i]
-                responses: Dict[int, EngineResponse] = {}
-                for p_idx, rr in acc[k]:
-                    resp = responses.get(p_idx)
-                    if resp is None:
-                        resp = self._new_response(p_idx, res_doc, now,
-                                                  wrapped[i])
-                        responses[p_idx] = resp
-                    if rr is None:
+                row_js = np.flatnonzero(sub_match[k] & self._dev_mask)
+                st_row = status[k]
+                det_row = detail[k]
+                for j in row_js.tolist():
+                    prog = progs[j]
+                    if background_mode and not background_ok[j]:
+                        acc[k].append((prog.policy_index, None))
                         continue
-                    pr = resp.policy_response
-                    pr.rules.append(rr)
-                    s = rr.status
-                    if s == RuleStatus.PASS or s == RuleStatus.FAIL:
-                        pr.rules_applied_count += 1
-                    elif s == RuleStatus.ERROR:
-                        pr.rules_error_count += 1
-                for p_idx in self._host_policy_idx:
-                    if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
-                        responses[p_idx] = self._host_run(p_idx, res_doc)
-                    else:
-                        responses[p_idx] = self._new_response(
-                            p_idx, res_doc, now, wrapped[i])
-                out.append([responses[q] for q in sorted(responses)])
-        return out
+                    rr = self._cell(prog, j, int(st_row[j]),
+                                    int(det_row[j]), fdet[k], ts, fly)
+                    if rr is _HOST:
+                        rr = self._materialize(prog,
+                                               resources[start + k])
+                        if rr is not None:
+                            rr.timestamp = ts
+                    acc[k].append((prog.policy_index,
+                                   None if rr is None or rr is _HOST
+                                   else rr))
+        else:
+            for j, prog in self.device_programs:
+                rows = np.flatnonzero(sub_match[:, j])
+                if rows.size == 0:
+                    continue
+                p_idx = prog.policy_index
+                if background_mode and not background_ok[j]:
+                    # background-disabled policies contribute an empty
+                    # response (engine.py:174 apply_background_checks)
+                    for k in rows.tolist():
+                        acc[k].append((p_idx, None))
+                    continue
+                st_col = status[rows, j].tolist()
+                det_col = detail[rows, j].tolist()
+                for k, st, det in zip(rows.tolist(), st_col, det_col):
+                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly)
+                    if rr is _HOST:
+                        # anchor-SKIP / HOST / unsynthesizable FAIL:
+                        # re-run on the host for exact status+message
+                        rr = self._materialize(prog,
+                                               resources[start + k])
+                        if rr is not None:
+                            rr.timestamp = ts
+                    acc[k].append((p_idx, None if rr is None or
+                                   rr is _HOST else rr))
+        chunk_rows: List[List[EngineResponse]] = []
+        for k in range(m):
+            i = start + k
+            res_doc = resources[i]
+            responses: Dict[int, EngineResponse] = {}
+            for p_idx, rr in acc[k]:
+                resp = responses.get(p_idx)
+                if resp is None:
+                    resp = self._new_response(p_idx, res_doc, now,
+                                              wrapped[i])
+                    responses[p_idx] = resp
+                if rr is None:
+                    continue
+                pr = resp.policy_response
+                pr.rules.append(rr)
+                st = rr.status
+                if st == RuleStatus.PASS or st == RuleStatus.FAIL:
+                    pr.rules_applied_count += 1
+                elif st == RuleStatus.ERROR:
+                    pr.rules_error_count += 1
+            for p_idx in self._host_policy_idx:
+                if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
+                    responses[p_idx] = self._host_run(p_idx, res_doc)
+                else:
+                    responses[p_idx] = self._new_response(
+                        p_idx, res_doc, now, wrapped[i])
+            chunk_rows.append([responses[q] for q in sorted(responses)])
+        return chunk_rows
 
     def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
               fly: Dict[Tuple, Any]):

@@ -174,9 +174,11 @@ class BackgroundScanController:
         if not work:
             return []
         now = time.time()
-        scanned = self.scanner.scan(work)
+        # stream: report construction + CR writes overlap the next
+        # chunk's encode/transfer/device stages
         reports = []
-        for uid, resource, responses in zip(uids, work, scanned):
+        for uid, resource, responses in zip(
+                uids, work, self.scanner.scan_stream(work)):
             report = self._store_report(uid, resource, responses, now)
             self._scanned[uid] = (calculate_resource_hash(resource), now)
             if report is not None:

@@ -151,3 +151,22 @@ class TestProfiling:
             if s.profiling_server:
                 s.profiling_server.stop()
             tracing.disable()
+
+
+class TestStreamingSpans:
+    def test_early_stopped_stream_records_no_error_spans(self, mem):
+        """zip() consumers never exhaust scan_stream; the abandoned
+        generator's close must not export error-status spans or leak
+        the current-span contextvar into the consumer."""
+        import gc
+        from kyverno_tpu.compiler.scan import BatchScanner
+        from kyverno_tpu.observability import tracing
+        scanner = BatchScanner([Policy(POLICY)])
+        pods = [pod() for _ in range(6)]
+        stream = scanner.scan_stream(pods)
+        next(stream)                       # consume one resource
+        assert tracing.current_span() is None   # no contextvar leak
+        del stream                         # abandon mid-stream
+        gc.collect()
+        for span in mem.find('kyverno/device/scan'):
+            assert span.status != 'error', span.__dict__
