@@ -481,31 +481,31 @@ class BatchScanner:
             self.policies[p.policy_index].background for p in progs])
 
         # the device chunks stream through while this loop assembles —
-        # three pipeline stages (encode / device / assemble) overlap.
-        # Large chunks assemble column-wise (per program over the whole
-        # chunk): the status branch, message lookup and int casts
-        # amortize over all rows of a column.  Small batches (admission:
-        # one resource) assemble row-wise — a column sweep would pay one
-        # numpy call per program for a single resource.  Identical
-        # device-synthesized cells share one flyweight RuleResponse
-        # (treat rule responses from scan() as immutable — every
-        # downstream consumer only reads).
+        # three pipeline stages (encode / device / assemble) overlap;
+        # assembly strategy details live in _assemble_chunk.
+        # each span covers one chunk's device wait + host assembly and
+        # opens/closes within a single generator step (no yield inside
+        # the with-block): holding one span across yields would leak the
+        # current-span contextvar into the consumer and record a bogus
+        # error when the consumer stops iterating early
         from ..observability import tracing
-        for start, status, detail, fdet in \
-                self._device_status_chunks(resources, contexts):
-            # the span opens and closes within this single generator
-            # step (no yield inside the with-block): holding one span
-            # across yields would leak the current-span contextvar into
-            # the consumer and record a bogus error when the consumer
-            # stops iterating early
+        chunks = self._device_status_chunks(resources, contexts)
+        start = 0
+        while start < n:
             with tracing.start_span(
                     'kyverno/device/scan',
-                    {'chunk_start': start, 'resources': status.shape[0],
-                     'programs': len(progs)}):
+                    {'chunk_start': start,
+                     'programs': len(progs)}) as span:
+                try:
+                    start, status, detail, fdet = next(chunks)
+                except StopIteration:
+                    return
+                span.set_attribute('resources', status.shape[0])
                 chunk_rows = self._assemble_chunk(
                     resources, wrapped, match, start, status, detail,
                     fdet, now, ts, background_mode, background_ok,
                     host_maybe)
+            start += status.shape[0]
             yield from chunk_rows
 
     def _assemble_chunk(self, resources, wrapped, match, start, status,

@@ -125,4 +125,10 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     tensors, layout = shard_tensors(raw, mesh, axis)
     step = _cached_sharded_evaluator(cps, mesh, axis)
     statuses, details, summary = step(tensors, layout)
+    if jax.process_count() > 1:
+        # multi-host: each process only holds its local shards of the
+        # batch axis — gather the full status matrix across hosts (the
+        # psum'd summary is already replicated on every device)
+        from jax.experimental import multihost_utils
+        statuses = multihost_utils.process_allgather(statuses, tiled=True)
     return np.asarray(statuses)[:n], np.asarray(summary)
