@@ -1183,6 +1183,191 @@ def enable_persistent_compilation_cache() -> Optional[str]:
     return cache_dir
 
 
+# ---------------------------------------------------------------------------
+# AOT executable cache.  The persistent XLA compilation cache only skips
+# the backend compile; a fresh process still pays ~10s re-tracing the
+# evaluator (the jaxpr for a full policy pack lowers to ~4MB of
+# StableHLO) plus the cache deserialize.  Serializing the *compiled
+# executable* (jax.experimental.serialize_executable) keyed by
+# (policy-set fingerprint, input signature, platform) skips trace AND
+# compile: a fresh process reaches device-served scans in seconds.
+
+_AOT_VERSION = 1
+_SOURCE_DIGEST: Optional[str] = None
+
+
+def _source_digest() -> str:
+    """Digest of the compiler/evaluator sources: any code change
+    invalidates AOT entries (the executable bakes in their semantics)."""
+    global _SOURCE_DIGEST
+    if _SOURCE_DIGEST is None:
+        import hashlib
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ('ops/eval.py', 'compiler/compile.py',
+                    'compiler/encode.py', 'compiler/ir.py',
+                    'compiler/pss_compile.py'):
+            try:
+                with open(os.path.join(base, rel), 'rb') as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(rel.encode())
+        _SOURCE_DIGEST = h.hexdigest()[:16]
+    return _SOURCE_DIGEST
+
+
+def policy_set_fingerprint(policies) -> str:
+    """Stable digest of a policy set's raw documents (the evaluator HLO
+    is a deterministic function of them — verified cross-process)."""
+    import hashlib
+    import json
+    payload = json.dumps([getattr(p, 'raw', p) for p in policies],
+                         sort_keys=True, separators=(',', ':'),
+                         default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:20]
+
+
+def _aot_cache_dir() -> Optional[str]:
+    if os.environ.get('KTPU_AOT', '1') != '1':
+        return None
+    d = os.environ.get(
+        'KTPU_AOT_CACHE',
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), '.cache', 'aot'))
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return None
+    return d
+
+
+def _aot_key(fingerprint: str, packed: Dict[str, Any]) -> Optional[str]:
+    """Cache key for one (policy set, input signature, platform) combo.
+    Returns None when the inputs are sharded across >1 device (mesh
+    path: executables embed the device assignment — not portable)."""
+    import hashlib
+    try:
+        sig = []
+        backend = jax.default_backend()
+        platform = backend
+        for name in sorted(packed):
+            v = packed[name]
+            sharding = getattr(v, 'sharding', None)
+            if sharding is not None:
+                devs = getattr(sharding, 'device_set', None)
+                if devs is not None:
+                    if len(devs) != 1:
+                        return None
+                    d = next(iter(devs))
+                    backend = d.platform
+                    platform = f'{d.platform}:{getattr(d, "id", 0)}'
+            sig.append((name, str(v.dtype), tuple(v.shape)))
+        # deserialize_and_load reloads executables across ALL local
+        # devices of the backend: a 1-device executable mis-loads as an
+        # N-shard SPMD program on multi-device hosts (verified on the
+        # 8-virtual-device CPU test env) — AOT only on 1-device backends
+        if len(jax.local_devices(backend=backend)) != 1:
+            return None
+        payload = repr((_AOT_VERSION, _source_digest(), jax.__version__,
+                        jax.lib.__version__, platform, fingerprint, sig))
+        return hashlib.sha256(payload.encode()).hexdigest()[:32]
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return None
+
+
+def _aot_load(key: str):
+    d = _aot_cache_dir()
+    if d is None:
+        return None
+    path = os.path.join(d, f'{key}.exe.zst')
+    if not os.path.exists(path):
+        return None
+    try:
+        import pickle
+        import zstandard
+        from jax.experimental import serialize_executable as se
+        with open(path, 'rb') as f:
+            blob = zstandard.ZstdDecompressor().decompress(f.read())
+        payload, in_tree, out_tree = pickle.loads(blob)
+        loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 - stale/corrupt entry: recompile
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    try:
+        os.utime(path)  # LRU eviction works off mtime
+    except OSError:  # a touch failure must not void a good load
+        pass
+    return loaded
+
+
+def _aot_store_async(key: str, compiled) -> None:
+    """Serialize + write in a daemon thread (~40MB compressed for a
+    full-pack chunk executable; must not block the scan path)."""
+    d = _aot_cache_dir()
+    if d is None:
+        return
+
+    def work():
+        try:
+            import pickle
+            import tempfile
+            import zstandard
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = zstandard.ZstdCompressor(level=3).compress(
+                pickle.dumps((payload, in_tree, out_tree)))
+            _aot_evict(d, budget=int(os.environ.get(
+                'KTPU_AOT_CACHE_MAX', str(8 << 30))) - len(blob))
+            fd, tmp = tempfile.mkstemp(dir=d, suffix='.tmp')
+            with os.fdopen(fd, 'wb') as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(d, f'{key}.exe.zst'))
+        except Exception:  # noqa: BLE001 - cache write is best-effort
+            pass
+
+    import threading
+    threading.Thread(target=work, daemon=True,
+                     name=f'aot-store-{key[:8]}').start()
+
+
+def _aot_evict(d: str, budget: int) -> None:
+    """Drop oldest entries until the directory fits the byte budget."""
+    try:
+        import time as _time
+        entries = []
+        for name in os.listdir(d):
+            p = os.path.join(d, name)
+            if name.endswith('.tmp'):
+                # orphaned partial writes from killed processes — the
+                # atomic-rename protocol never leaves a fresh .tmp behind
+                # for long, so stale ones are garbage
+                try:
+                    if _time.time() - os.stat(p).st_mtime > 600:
+                        os.unlink(p)
+                except OSError:
+                    pass
+                continue
+            if not name.endswith('.exe.zst'):
+                continue
+            st = os.stat(p)
+            entries.append((st.st_mtime, st.st_size, p))
+        entries.sort()
+        total = sum(sz for _, sz, _ in entries)
+        for _, sz, p in entries:
+            if total <= max(budget, 0):
+                break
+            try:
+                os.unlink(p)
+                total -= sz
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
 def build_evaluator(cps: CompiledPolicySet):
     enable_persistent_compilation_cache()
     slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
@@ -1607,19 +1792,55 @@ def build_evaluator(cps: CompiledPolicySet):
         return evaluate(unpack_batch(packed, layout_holder['layout']))
 
     jitted = jax.jit(evaluate_packed)
+    fingerprint = policy_set_fingerprint(cps.policies)
+    exec_cache: Dict[str, Any] = {}
+    # one lock covers exec_cache AND every trace of evaluate_packed:
+    # the trace reads layout_holder, so an unsynchronized concurrent
+    # call could bake another batch shape's layout into the executable
+    # (and the AOT store would persist the poisoned artifact to disk)
+    compile_lock = __import__('threading').RLock()
 
-    def call(packed: Dict[str, Any], layout: Dict[str, Tuple[str, int]]):
+    def _compiled_for(packed, layout) -> Optional[Any]:
+        """Executable for this input signature: memory → AOT disk →
+        trace+compile (and populate both).  None → mesh-sharded inputs
+        or AOT disabled; caller falls back to the jitted path."""
+        key = _aot_key(fingerprint, packed)
+        if key is None:
+            return None
+        with compile_lock:
+            hit = exec_cache.get(key)
+            if hit is not None:
+                return hit
+            loaded = _aot_load(key)
+            if loaded is None:
+                layout_holder['layout'] = layout
+                loaded = jitted.lower(packed).compile()
+                _aot_store_async(key, loaded)
+            exec_cache[key] = loaded
+            return loaded
+
+    def call(packed: Dict[str, Any],
+             layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]]):
         # i64 lanes are required: quantity milli-values span past 2^31.
         # Scope x64 to this call instead of flipping the process-global
         # flag at import time.
-        layout_holder['layout'] = layout
         with enable_x64():
-            return jitted(packed)
+            try:
+                compiled = _compiled_for(packed, layout)
+            except Exception:  # noqa: BLE001 - AOT is an optimization
+                compiled = None
+            if compiled is not None:
+                return compiled(packed)
+            with compile_lock:
+                layout_holder['layout'] = layout
+                return jitted(packed)
 
     call.jitted = jitted
     call.raw = evaluate
     call.layout_holder = layout_holder
+    call.compile_lock = compile_lock
     call.any_meta = any_meta
+    call.fingerprint = fingerprint
     return call
 
 
@@ -1628,36 +1849,50 @@ def enable_x64():
 
 
 def pack_batch(tensors: Dict[str, np.ndarray]):
-    """Stack same-shaped lanes into a handful of [K, R, ...] buffers.
+    """Coalesce all lanes into ONE flat [R, W] buffer per dtype.
 
     The encoder produces hundreds of small per-lane arrays; transferring
     each individually costs one host→device round trip apiece (dominant
-    over the remote-TPU tunnel).  Packing groups them by (dtype,
-    trailing shape) into a few big buffers; the evaluator unpacks with
-    static slices that XLA folds away.
+    over a remote-TPU tunnel, where per-transfer latency — not
+    bandwidth — bounds the pipeline).  Every lane has the resource axis
+    leading, so each is viewed as [R, prod(rest)] and concatenated per
+    dtype; the evaluator unpacks with static slices + reshapes that XLA
+    folds away.  Five dtypes → five host→device transfers per chunk.
     """
-    groups: Dict[Tuple, List[Tuple[str, np.ndarray]]] = {}
+    groups: Dict[str, List[Tuple[str, np.ndarray]]] = {}
     for name, arr in sorted(tensors.items()):
-        key = (str(arr.dtype), arr.shape[1:])
-        groups.setdefault(key, []).append((name, arr))
+        groups.setdefault(str(arr.dtype), []).append((name, arr))
     packed: Dict[str, np.ndarray] = {}
-    layout: Dict[str, Tuple[str, int]] = {}
-    for gi, (key, members) in enumerate(sorted(groups.items())):
-        packed[f'pk{gi}'] = np.stack([arr for _, arr in members])
-        for mi, (name, _) in enumerate(members):
-            layout[name] = (f'pk{gi}', mi)
+    layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]] = {}
+    for dt, members in sorted(groups.items()):
+        r = members[0][1].shape[0]
+        parts: List[np.ndarray] = []
+        off = 0
+        for name, arr in members:
+            flat = arr.reshape(r, -1)
+            layout[name] = (f'pk_{dt}', off, flat.shape[1], arr.shape[1:])
+            parts.append(flat)
+            off += flat.shape[1]
+        packed[f'pk_{dt}'] = parts[0] if len(parts) == 1 \
+            else np.concatenate(parts, axis=1)
     return packed, layout
 
 
 def unpack_batch(packed: Dict[str, Any],
-                 layout: Dict[str, Tuple[str, int]]) -> Dict[str, Any]:
-    return {name: packed[g][i] for name, (g, i) in layout.items()}
+                 layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]]
+                 ) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name, (g, off, width, tail) in layout.items():
+        buf = packed[g]
+        sl = buf[:, off:off + width]
+        out[name] = sl.reshape((buf.shape[0],) + tuple(tail))
+    return out
 
 
 def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
                 axis: str = 'data', device=None) -> Dict[str, Any]:
     """Pack + place batch tensors, optionally sharded over a 1-D mesh
-    (the resource axis of packed stacks is axis 1) or pinned to an
+    (the resource axis of packed buffers is axis 0) or pinned to an
     explicit single device (small-batch CPU path).  int64 inputs are
     transferred inside an x64 scope so they are not downcast.  Returns
     (packed_device_dict, layout)."""
@@ -1671,6 +1906,6 @@ def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
             return {k: jnp.asarray(v) for k, v in packed.items()}, layout
         out = {}
         for k, v in packed.items():
-            spec = P(None, axis, *([None] * (v.ndim - 2)))
+            spec = P(axis, *([None] * (v.ndim - 1)))
             out[k] = jax.device_put(v, NamedSharding(mesh, spec))
         return out, layout

@@ -71,9 +71,13 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     jitted = jax.jit(step, out_shardings=out_shardings)
 
     def run(tensors, layout):
-        evaluator.layout_holder['layout'] = layout
-        with enable_x64():
-            return jitted(tensors)
+        # layout_holder is shared with the single-device evaluator's
+        # traces — take its compile lock so a concurrent call cannot
+        # bake this layout into the wrong executable
+        with evaluator.compile_lock:
+            evaluator.layout_holder['layout'] = layout
+            with enable_x64():
+                return jitted(tensors)
 
     return run
 
