@@ -239,11 +239,31 @@ def _check_no_vars(value: Any) -> None:
             _check_no_vars(v)
 
 
+# wildcard pattern-key path segment: '\x00wk:<pattern>' resolves, per
+# resource, to the FIRST map key matching <pattern> (the device form of
+# wildcards.ExpandInMetadata — reference pkg/engine/wildcards/wildcards.go:62)
+WILD_KEY_MARK = '\x00wk:'
+# site template sentinel: the failing path embeds a per-resource resolved
+# key, so the message cannot be synthesized — FAIL cells go to the host
+DYNAMIC_SITE = '\x00dyn'
+
+
+def _wild_key_allowed(path: Tuple[str, ...]) -> bool:
+    """Wildcard pattern keys resolve per-resource only under
+    metadata.labels / metadata.annotations — the exact scope of the
+    reference's ExpandInMetadata (wildcards.go:62, applied at every
+    validateMap level, so any autogen prefix is fine)."""
+    return len(path) >= 2 and path[-1] in ('labels', 'annotations') \
+        and path[-2] == 'metadata'
+
+
 def _path_template(path: Tuple[str, ...], parent: bool = False) -> str:
     """Host walk path for a slot path: '/spec/containers/{e0}/image/'.
     ``parent`` drops the last component (the map-level '*' shortcut
     reports the parent map's path — anchor.py:214)."""
     parts = path[:-1] if parent else path
+    if any(p.startswith(WILD_KEY_MARK) for p in parts):
+        return DYNAMIC_SITE
     out = '/'
     e = 0
     for p in parts:
@@ -320,8 +340,23 @@ def _compile_map(cps: CompiledPolicySet, pattern: dict,
     for key in sorted(anchors, key=_phase1_sort_key):
         a, value = anchors[key]
         if _key_has_wildcard(a.key):
-            raise CompileError(f'wildcard pattern key not vectorized: {key}')
-        child_path = path + (a.key,)
+            # first-match key resolution happens at encode time (the
+            # encoder sees the document); the host sorts phase-1 anchors
+            # by the RESOLVED key, so sibling ordering is only exact
+            # when the wildcard key is alone in its map
+            if not _wild_key_allowed(path) or anchor_mod.is_existence(a) \
+                    or len(pattern) != 1:
+                raise CompileError(
+                    f'wildcard pattern key not vectorized: {key}')
+            if not isinstance(value, (str, int, float, bool)) \
+                    and value is not None:
+                raise CompileError(
+                    f'wildcard pattern key with non-scalar value: {key}')
+            # ExpandInMetadata stringifies the pattern values it rewrites
+            value = str(value)
+            child_path = path + (WILD_KEY_MARK + a.key,)
+        else:
+            child_path = path + (a.key,)
         slot = Slot(child_path)
         _require_depth(slot)
         cps.slot_id(slot)
@@ -357,8 +392,19 @@ def _compile_map(cps: CompiledPolicySet, pattern: dict,
         a, value = plains[key]
         bare = a.key if a else key
         if _key_has_wildcard(bare):
-            raise CompileError(f'wildcard pattern key not vectorized: {key}')
-        child_path = path + (bare,)
+            if not _wild_key_allowed(path) or a is not None \
+                    or len(pattern) != 1:
+                raise CompileError(
+                    f'wildcard pattern key not vectorized: {key}')
+            if not isinstance(value, (str, int, float, bool)) \
+                    and value is not None:
+                raise CompileError(
+                    f'wildcard pattern key with non-scalar value: {key}')
+            if value != '*':
+                value = str(value)
+            child_path = path + (WILD_KEY_MARK + bare,)
+        else:
+            child_path = path + (bare,)
         if a is not None and anchor_mod.is_global(a):
             slot = Slot(child_path)
             _require_depth(slot)

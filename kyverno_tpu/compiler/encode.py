@@ -39,6 +39,7 @@ import numpy as np
 
 from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
+from ..utils.wildcard import match as _wild_match
 from .ir import (MAX_ELEMS, MAX_GATHER, STR_LEN, TAG_ARRAY, TAG_BOOL,
                  TAG_FLOAT, TAG_INT, TAG_MAP, TAG_MISSING, TAG_NULL,
                  TAG_STRING, TAIL_LEN, CompiledPolicySet, GatherSlot, Slot,
@@ -512,6 +513,19 @@ def _walk(doc: Any, path: Tuple[str, ...]):
     cur = doc
     for key in path:
         if isinstance(cur, dict):
+            if key.startswith('\x00'):
+                # wildcard pattern-key segment (compile.WILD_KEY_MARK):
+                # descend into the FIRST key matching the pattern, in
+                # document order — mirrors ExpandInMetadata's
+                # first-match rewrite (validate_pattern.py:202)
+                pat = key[4:]
+                for rk in cur:
+                    if _wild_match(pat, str(rk)):
+                        cur = cur[rk]
+                        break
+                else:
+                    return _MISSING
+                continue
             if key not in cur:
                 return _MISSING
             cur = cur[key]

@@ -312,20 +312,25 @@ class BatchScanner:
         return None
 
     def _device_status_chunks(self, resources: List[dict],
-                              contexts: Optional[List[dict]] = None):
+                              contexts: Optional[List[dict]] = None,
+                              match: Optional[np.ndarray] = None):
         """Yield ``(start, status, detail, fdet)`` per fixed-size chunk.
 
         Three-stage pipeline: an encode thread projects chunk i+2 onto the
         slot table while a dispatch thread streams chunk i+1 to the device
         and the caller (response assembly / aggregation) consumes chunk i
-        — end-to-end rate ≈ max(stage) instead of sum(stage)."""
+        — end-to-end rate ≈ max(stage) instead of sum(stage).
+
+        ``match`` (the host-side [R, P] match mask) rides to the device
+        with each chunk so fail details compact to the (matched, FAIL)
+        cells — d2h bytes drop ~3× over a remote-TPU tunnel."""
         n = len(resources)
         if not self.cps.programs or not resources:
             z = np.zeros((n, len(self.cps.programs)), np.int8)
             yield 0, z, z, z.astype(np.int32)
             return
         from concurrent.futures import ThreadPoolExecutor
-        from ..ops.eval import shard_batch
+        from ..ops.eval import expand_compact, shard_batch
         chunk = self.CHUNK
         small = self.mesh is None and n <= self.SMALL_BATCH
         device = self._small_device() if small else None
@@ -356,7 +361,7 @@ class BatchScanner:
                     pass
             return inline_encode(part, part_ctx, bucket), len(part)
 
-        def dispatch(enc_future):
+        def dispatch(enc_future, start):
             tensors, ln = enc_future.result()
             if not isinstance(tensors, dict):
                 # AsyncResult from the fork pool: a dead/OOM-killed worker
@@ -375,8 +380,23 @@ class BatchScanner:
                         self._encoder_pool.close()
                         self._encoder_pool._broken = True
                         tensors = inline_encode(part, part_ctx, bucket)
+            if match is not None and self.mesh is None and tensors:
+                padded = next(iter(tensors.values())).shape[0]
+                mm = np.zeros((padded, match.shape[1]), np.uint8)
+                # host-policy program columns are never read from fdet
+                # (_assemble_chunk ANDs with _dev_mask) — keep their
+                # FAIL cells out of the per-row compaction budget
+                mm[:ln] = match[start:start + ln] & self._dev_mask
+                tensors = dict(tensors)
+                tensors['__match__'] = mm
             t, layout = shard_batch(tensors, self.mesh, device=device)
-            s, d, fd = self._evaluator(t, layout)
+            out = self._evaluator(t, layout)
+            if len(out) == 2:
+                s, d, fd = expand_compact(
+                    np.asarray(out[0]), np.asarray(out[1]),
+                    self._evaluator.n_programs, self._evaluator.n_cols)
+                return s[:ln], d[:ln], fd[:ln]
+            s, d, fd = out
             return (np.asarray(s)[:ln], np.asarray(d)[:ln],
                     np.asarray(fd)[:ln])
 
@@ -389,7 +409,7 @@ class BatchScanner:
 
                 def result(self):
                     return self._v
-            yield (0, *dispatch(_Now(encode(0))))
+            yield (0, *dispatch(_Now(encode(0)), 0))
             return
 
         from collections import deque
@@ -400,7 +420,8 @@ class BatchScanner:
                 inflight.append(
                     (start,
                      disp_pool.submit(dispatch,
-                                      enc_pool.submit(encode, start))))
+                                      enc_pool.submit(encode, start),
+                                      start)))
                 while len(inflight) > 2:
                     s0, f = inflight.popleft()
                     yield (s0, *f.result())
@@ -409,8 +430,9 @@ class BatchScanner:
                 yield (s0, *f.result())
 
     def _device_statuses(self, resources: List[dict],
-                         contexts: Optional[List[dict]] = None):
-        parts = list(self._device_status_chunks(resources, contexts))
+                         contexts: Optional[List[dict]] = None,
+                         match: Optional[np.ndarray] = None):
+        parts = list(self._device_status_chunks(resources, contexts, match))
         if len(parts) == 1:
             return parts[0][1:]
         return tuple(np.concatenate([p[i] for p in parts])
@@ -421,8 +443,8 @@ class BatchScanner:
         — the allocation-free fast path for throughput measurement and
         report aggregation."""
         wrapped = [Resource(r) for r in resources]
-        status, detail, _ = self._device_statuses(resources)
         match = self.match_matrix(resources, wrapped)
+        status, detail, _ = self._device_statuses(resources, match=match)
         return status, detail, match
 
     # -- full responses -----------------------------------------------------
@@ -489,7 +511,7 @@ class BatchScanner:
         # current-span contextvar into the consumer and record a bogus
         # error when the consumer stops iterating early
         from ..observability import tracing
-        chunks = self._device_status_chunks(resources, contexts)
+        chunks = self._device_status_chunks(resources, contexts, match)
         start = 0
         while start < n:
             with tracing.start_span(
@@ -595,7 +617,13 @@ class BatchScanner:
                 elif st == RuleStatus.ERROR:
                     pr.rules_error_count += 1
             for p_idx in self._host_policy_idx:
-                if host_maybe[p_idx] is None or host_maybe[p_idx][i]:
+                if background_mode and not self._policy_header[p_idx][0].background:
+                    # background-disabled policy: empty response without
+                    # a host-engine round trip (engine.py:174
+                    # apply_background_checks short-circuit)
+                    responses[p_idx] = self._new_response(
+                        p_idx, res_doc, now, wrapped[i])
+                elif host_maybe[p_idx] is None or host_maybe[p_idx][i]:
                     responses[p_idx] = self._host_run(p_idx, res_doc)
                 else:
                     responses[p_idx] = self._new_response(
@@ -701,8 +729,12 @@ class BatchScanner:
         return maybe
 
     @staticmethod
-    def _site_path(sites: Tuple[str, ...], fd: int) -> str:
+    def _site_path(sites: Tuple[str, ...], fd: int) -> Optional[str]:
         tmpl = sites[fd >> 16]
+        if tmpl.startswith('\x00'):
+            # DYNAMIC_SITE: the path embeds a per-resource resolved
+            # wildcard key — host materialization produces the message
+            return None
         if '{' in tmpl:
             tmpl = tmpl.replace('{e0}', str(fd & 0xFF)) \
                        .replace('{e1}', str((fd >> 8) & 0xFF))
@@ -750,6 +782,8 @@ class BatchScanner:
                 if fd_c < 0:
                     return None
                 path = self._site_path(prog.any_fail_sites[c], fd_c)
+                if path is None:
+                    return None
                 parts.append(f'rule {prog.rule_name}[{c}] failed at '
                              f'path {path}')
             if not parts or prog.any_fail_prefix is None:
@@ -762,7 +796,10 @@ class BatchScanner:
             return prog.deny_fail_message
         if prog.fail_prefix is None or prog.fail_sites is None:
             return None
-        return prog.fail_prefix + self._site_path(prog.fail_sites, fd)
+        site = self._site_path(prog.fail_sites, fd)
+        if site is None:
+            return None
+        return prog.fail_prefix + site
 
     def _pctx(self, policy: Policy, resource: dict) -> PolicyContext:
         factory = getattr(self, '_pctx_factory', None)
@@ -784,19 +821,37 @@ class BatchScanner:
     def _new_response(self, policy_index: int, resource: dict,
                       now: float,
                       wrapped: Optional[Resource] = None) -> EngineResponse:
-        policy, name, namespace, vfa, vfa_overrides = \
-            self._policy_header[policy_index]
-        resp = EngineResponse(policy, patched_resource=resource)
-        pr = resp.policy_response
-        pr.policy_name = name
-        pr.policy_namespace = namespace
+        # template-copy fast path: the per-policy header fields are
+        # static for the scanner's lifetime, and scans build one
+        # response per (resource, policy) pair — copy.copy of a
+        # prebuilt template halves the construction cost vs setting
+        # every field through __init__
+        import copy as _copy
+        templates = getattr(self, '_resp_templates', None)
+        if templates is None:
+            templates = self._resp_templates = {}
+        tmpl = templates.get(policy_index)
+        if tmpl is None:
+            policy, name, namespace, vfa, vfa_overrides = \
+                self._policy_header[policy_index]
+            tmpl = EngineResponse(policy)
+            pr = tmpl.policy_response
+            pr.policy_name = name
+            pr.policy_namespace = namespace
+            pr.validation_failure_action = vfa
+            pr.validation_failure_action_overrides = vfa_overrides
+            templates[policy_index] = tmpl
+        resp = _copy.copy(tmpl)
+        resp.patched_resource = resource
+        resp.namespace_labels = {}
+        pr = _copy.copy(tmpl.policy_response)
+        resp.policy_response = pr
+        pr.rules = []
         r = wrapped if wrapped is not None else Resource(resource)
         pr.resource_name = r.name
         pr.resource_namespace = r.namespace
         pr.resource_kind = r.kind
         pr.resource_api_version = r.api_version
-        pr.validation_failure_action = vfa
-        pr.validation_failure_action_overrides = vfa_overrides
         pr.timestamp = int(now)
         return resp
 

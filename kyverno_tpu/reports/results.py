@@ -72,6 +72,55 @@ def _policy_static(policy) -> dict:
     return template
 
 
+# flyweight report-result cache: batch scans share RuleResponse objects
+# across resources (scan.py flyweights), so the result dict for one
+# (rule response, policy, second) triple is identical for every resource
+# — reuse it instead of rebuilding.  Consumers treat report results as
+# immutable (they are serialized into CRs, never mutated in place).
+# Keyed by id() with the rule response pinned in the value for identity
+# verification, like _POLICY_STATIC_CACHE.
+_RESULT_CACHE: Dict[int, tuple] = {}
+
+
+def _rule_result(rule, key: str, scored: bool, category, severity,
+                 ts: dict, now: int) -> dict:
+    rid = id(rule)
+    hit = _RESULT_CACHE.get(rid)
+    if hit is not None and hit[0] is rule and hit[1] == now \
+            and hit[2] == key:
+        return hit[3]
+    r = to_policy_result(rule.status)
+    if r == STATUS_FAIL and not scored:
+        r = STATUS_WARN
+    result = {
+        'source': 'kyverno',
+        'policy': key,
+        'rule': rule.name,
+        'message': rule.message,
+        'result': r,
+        'scored': scored,
+        'timestamp': ts,
+    }
+    if category:
+        result['category'] = category
+    if severity:
+        result['severity'] = severity
+    checks = rule.pod_security_checks
+    if checks:
+        controls = sorted(c['id'] for c in checks.get('checks', [])
+                          if not c.get('allowed', True))
+        if controls:
+            result['properties'] = {
+                'standard': checks.get('level', ''),
+                'version': checks.get('version', ''),
+                'controls': ','.join(controls),
+            }
+    if len(_RESULT_CACHE) > 16384:
+        _RESULT_CACHE.clear()
+    _RESULT_CACHE[rid] = (rule, now, key, result)
+    return result
+
+
 def engine_response_to_report_results(response: EngineResponse,
                                       now: Optional[int] = None
                                       ) -> List[dict]:
@@ -81,36 +130,8 @@ def engine_response_to_report_results(response: EngineResponse,
     if now is None:
         now = int(time.time())
     ts = {'seconds': now}
-    results = []
-    for rule in response.policy_response.rules:
-        r = to_policy_result(rule.status)
-        if r == STATUS_FAIL and not scored:
-            r = STATUS_WARN
-        result = {
-            'source': 'kyverno',
-            'policy': key,
-            'rule': rule.name,
-            'message': rule.message,
-            'result': r,
-            'scored': scored,
-            'timestamp': ts,
-        }
-        if category:
-            result['category'] = category
-        if severity:
-            result['severity'] = severity
-        checks = rule.pod_security_checks
-        if checks:
-            controls = sorted(c['id'] for c in checks.get('checks', [])
-                              if not c.get('allowed', True))
-            if controls:
-                result['properties'] = {
-                    'standard': checks.get('level', ''),
-                    'version': checks.get('version', ''),
-                    'controls': ','.join(controls),
-                }
-        results.append(result)
-    return results
+    return [_rule_result(rule, key, scored, category, severity, ts, now)
+            for rule in response.policy_response.rules]
 
 
 def sort_report_results(results: List[dict]) -> None:
