@@ -559,6 +559,11 @@ def cache_probe(platform: str) -> float:
     return -1.0
 
 
+def _peak_rss_mb() -> float:
+    import resource as _resource
+    return _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
 def run_bench(n: int, platform: str) -> dict:
     import random
     from kyverno_tpu.compiler.scan import BatchScanner
@@ -576,13 +581,16 @@ def run_bench(n: int, platform: str) -> dict:
     compile_s = time.time() - t0
     n_rules = len(scanner.cps.programs) + len(scanner.cps.host_rules)
 
-    # warm the jit cache at the real chunk shape (and the small-bucket
-    # shape) so the one-time XLA compile is excluded from steady state;
-    # reported separately — a policy-set change pays this again unless
-    # the persistent compilation cache hits
-    warm_n = min(n, scanner.CHUNK + 1)
+    # warm the jit cache at every bucket shape this run will hit (the
+    # full chunk + the tail remainder's power-of-two bucket) so the
+    # one-time XLA compile is excluded from steady state; reported
+    # separately — a fresh process skips this via the AOT executable
+    # cache (cache_warm_s below)
     t_warm = time.time()
-    scanner.scan(resources[:warm_n])
+    scanner.scan(resources[:min(n, scanner.CHUNK)])
+    tail = n % scanner.CHUNK
+    if n > scanner.CHUNK and tail:
+        scanner.scan(resources[:tail])
     warm_s = time.time() - t_warm
 
     # count host materializations to keep the device-decided fraction
@@ -599,34 +607,40 @@ def run_bench(n: int, platform: str) -> dict:
     # HEADLINE: the report-producing path — full EngineResponses with
     # host-identical messages, with BackgroundScanReport construction
     # streamed through the scan pipeline (what
-    # reports/controllers.py BackgroundScanController.reconcile runs);
-    # report building overlaps the next chunk's encode/device stages
+    # reports/controllers.py BackgroundScanController.reconcile runs).
+    # Reports are sunk incrementally (counted + summarized, then
+    # dropped) — the north-star 1M-Pod run must hold RSS bounded, which
+    # is exactly what scan_stream exists for.
+    host_policy_names = {scanner.policies[i].name
+                         for i in scanner._host_policy_idx}
+    rss_before_mb = _peak_rss_mb()
     t1 = time.time()
-    out = []
-    reports = []
+    decisions = 0
+    compiled_decisions = 0
+    n_reports = 0
+    report_results = 0
     for resource, responses in zip(resources,
                                    scanner.scan_stream(resources)):
-        out.append(responses)
         report = new_background_scan_report(resource)
         relevant = [r for r in responses if r.policy_response.rules]
         set_responses(report, *relevant)
-        reports.append(report)
+        n_reports += 1
+        report_results += len(report['results'])
+        for r in responses:
+            k = len(r.policy_response.rules)
+            decisions += k
+            if r.policy_response.policy_name not in host_policy_names:
+                compiled_decisions += k
     e2e_s = time.time() - t1
-    decisions = sum(len(r.policy_response.rules)
-                    for responses in out for r in responses)
-    # rule responses produced by compiled programs (host-policy rules run
-    # the host engine by design and must not dilute device_decided_frac)
-    host_policy_names = {scanner.policies[i].name
-                         for i in scanner._host_policy_idx}
-    compiled_decisions = sum(
-        len(r.policy_response.rules) for responses in out
-        for r in responses
-        if r.policy_response.policy_name not in host_policy_names)
+    peak_rss_mb = _peak_rss_mb()
     rate = decisions / e2e_s if e2e_s > 0 else 0.0
 
-    # the raw status sieve (no response objects), reported separately
+    # the raw status sieve (no response objects), reported separately on
+    # a bounded sample — at 1M the full-matrix variant alone would add
+    # many minutes without telling more than the sample does
+    sieve_n = min(n, 50_000)
     t3 = time.time()
-    status, detail, match = scanner.scan_statuses(resources)
+    status, detail, match = scanner.scan_statuses(resources[:sieve_n])
     sieve_s = time.time() - t3
     sieve_rate = int(match.sum()) / sieve_s if sieve_s > 0 else 0.0
     synth = (status == STATUS_PASS) | (status == STATUS_SKIP_PRECOND) | \
@@ -680,7 +694,8 @@ def run_bench(n: int, platform: str) -> dict:
         'n_rules': n_rules,
         'n_compiled_rules': len(scanner.cps.programs),
         'decisions': decisions,
-        'n_reports': len(reports),
+        'n_reports': n_reports,
+        'report_results': report_results,
         'device_decided_frac': round(device_decided_frac, 4),
         'materialized': materialized[0],
         'host_status_frac': round(host_status_frac, 4),
@@ -688,7 +703,10 @@ def run_bench(n: int, platform: str) -> dict:
         'compile_s': round(compile_s, 2),
         'warm_s': round(warm_s, 2),
         'e2e_s': round(e2e_s, 2),
+        'peak_rss_mb': round(peak_rss_mb, 1),
+        'rss_before_scan_mb': round(rss_before_mb, 1),
         'cache_warm_s': round(cache_warm_s, 2),
+        'sieve_n': sieve_n,
         'sieve_decisions_per_sec': round(sieve_rate, 1),
         'host_engine_decisions_per_sec': round(host_rate, 1),
         'speedup_vs_host_engine': round(rate / host_rate, 2)
@@ -760,7 +778,8 @@ def admission_latency(policies, resources, target_policies=1000,
 
 
 def main() -> int:
-    n = int(os.environ.get('BENCH_N', '50000'))
+    # default is the BASELINE.md north star: a 1M-Pod background scan
+    n = int(os.environ.get('BENCH_N', '1000000'))
     platform = os.environ.get('BENCH_PLATFORM') or probe_platform()
     if platform == 'cpu':
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')

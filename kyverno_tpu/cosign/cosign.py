@@ -17,8 +17,27 @@ Real signature cryptography over the cosign "simple signing" model:
   image's digest, and attestor ``annotations`` must be present in the
   payload's ``optional`` block (cosign.go payload checks).
 
-Rekor tlog checks are represented by ``ignore_tlog`` only: the hermetic
-environment has no transparency log, and entries carry no bundle.
+Rekor transparency-log verification is OFFLINE, from the signature
+entry's attached bundle (what ``cosign sign`` stores under the
+``dev.sigstore.cosign/bundle`` annotation), matching the reference's
+cosign-library behavior when a Rekor client is configured
+(pkg/cosign/cosign.go:204 buildCosignOptions → RekorClient; the
+library prefers the offline bundle when present):
+
+* the SignedEntryTimestamp must verify over the RFC 8785-canonical
+  JSON of {body, integratedTime, logID, logIndex} with the configured
+  Rekor public key (``rekor.pubkey`` in the policy's rekor block, or
+  the SIGSTORE_REKOR_PUBLIC_KEY env var — cosign's own override);
+* the bundle body (hashedrekord / rekord) must be consistent with the
+  verified signature: same payload hash and same signature bytes;
+* for keyless entries the integratedTime must fall inside the signing
+  certificate's validity window (cosign CheckExpiry).
+
+Per the reference CRD semantics (image_verification_types.go:149 "If
+the value is nil, Rekor is not checked"), tlog verification runs
+whenever the attestor carries a ``rekor:`` block (unless its
+``ignoreTlog`` is set) — and an entry without a valid bundle then
+FAILS verification.
 
 Legacy metadata-only entries (a bare ``key`` id, no payload) remain
 accepted ONLY when the attestor key is not a PEM block — the CLI mock
@@ -42,14 +61,15 @@ class Options:
 
     __slots__ = ('image_ref', 'key', 'cert', 'cert_chain', 'roots',
                  'subject', 'issuer', 'annotations', 'repository',
-                 'ignore_tlog', 'rekor_url', 'predicate_type',
-                 'fetch_attestations')
+                 'ignore_tlog', 'rekor_url', 'rekor_pubkey',
+                 'predicate_type', 'fetch_attestations')
 
     def __init__(self, image_ref: str, key: str = '', cert: str = '',
                  cert_chain: str = '', roots: str = '', subject: str = '',
                  issuer: str = '', annotations: Optional[dict] = None,
                  repository: str = '', ignore_tlog: bool = False,
-                 rekor_url: str = '', predicate_type: str = '',
+                 rekor_url: str = '', rekor_pubkey: str = '',
+                 predicate_type: str = '',
                  fetch_attestations: bool = False):
         self.image_ref = image_ref
         self.key = key
@@ -62,8 +82,16 @@ class Options:
         self.repository = repository
         self.ignore_tlog = ignore_tlog
         self.rekor_url = rekor_url
+        self.rekor_pubkey = rekor_pubkey
         self.predicate_type = predicate_type
         self.fetch_attestations = fetch_attestations
+
+    def tlog_required(self) -> bool:
+        """Tlog verification applies when the attestor configures Rekor
+        (CRD: 'If the value is nil, Rekor is not checked' —
+        image_verification_types.go:149) and ignoreTlog is unset."""
+        return bool(self.rekor_url or self.rekor_pubkey) and \
+            not self.ignore_tlog
 
 
 class Response:
@@ -253,6 +281,131 @@ def _verify_crypto_sig(sig: dict, payload: bytes, signature: bytes,
         raise VerificationError(
             f'certificate issuer {issuer!r} does not match '
             f'{opts.issuer!r}')
+    return leaf
+
+
+# ---------------------------------------------------------------------------
+# Rekor transparency log (offline bundle verification)
+
+def _rekor_public_key(opts: Options) -> str:
+    import os
+    pem = opts.rekor_pubkey or os.environ.get(
+        'SIGSTORE_REKOR_PUBLIC_KEY', '')
+    if not pem:
+        raise VerificationError(
+            'tlog verification required but no Rekor public key is '
+            'configured (rekor.pubkey or SIGSTORE_REKOR_PUBLIC_KEY)')
+    return pem
+
+
+def canonical_tlog_payload(bundle_payload: dict) -> bytes:
+    """RFC 8785-style canonical JSON of the Rekor log entry the
+    SignedEntryTimestamp covers (sigstore verifySET: sorted keys, no
+    whitespace)."""
+    return json.dumps({
+        'body': bundle_payload.get('body'),
+        'integratedTime': bundle_payload.get('integratedTime'),
+        'logID': bundle_payload.get('logID'),
+        'logIndex': bundle_payload.get('logIndex'),
+    }, sort_keys=True, separators=(',', ':')).encode()
+
+
+def _verify_tlog(sig: dict, payload: bytes, signature: bytes,
+                 opts: Options) -> int:
+    """Offline Rekor bundle verification; returns integratedTime.
+
+    Mirrors the cosign library's VerifyBundle path the reference engages
+    through cosign.go:204: SET signature over the canonical entry, then
+    entry↔signature consistency (hashedrekord / rekord body)."""
+    import hashlib
+    bundle = sig.get('bundle')
+    if not isinstance(bundle, dict):
+        raise VerificationError(
+            'tlog verification required but the signature carries no '
+            'transparency log bundle')
+    pl = bundle.get('Payload')
+    if not isinstance(pl, dict):
+        raise VerificationError('malformed tlog bundle: no Payload')
+    set_b64 = bundle.get('SignedEntryTimestamp', '')
+    try:
+        set_sig = base64.b64decode(set_b64)
+    except Exception as e:  # noqa: BLE001
+        raise VerificationError(f'undecodable SignedEntryTimestamp: {e}') \
+            from e
+    _verify_blob(_load_public_key(_rekor_public_key(opts)), set_sig,
+                 canonical_tlog_payload(pl))
+    # entry body must describe THIS signature (cosign
+    # verifyBundleMatchesSignature)
+    try:
+        body = json.loads(base64.b64decode(pl.get('body', '')))
+    except Exception as e:  # noqa: BLE001
+        raise VerificationError(f'undecodable tlog entry body: {e}') from e
+    kind = body.get('kind', '')
+    spec = body.get('spec') or {}
+    data = spec.get('data') or {}
+    if kind in ('intoto', 'dsse'):
+        # cosign attest logs attestations as intoto/dsse entries whose
+        # hash covers the logged envelope/payload; the raw-signature
+        # comparison of rekord entries does not apply.  Check the
+        # content hash against the signed payload when present.
+        content = spec.get('content') or {}
+        got = ((content.get('hash') or {}).get('value', '') or
+               (content.get('payloadHash') or {}).get('value', '') or
+               ((spec.get('envelopeHash') or {}).get('value', '')))
+        if got:
+            want = hashlib.sha256(payload).hexdigest()
+            if got.lower() != want:
+                raise VerificationError(
+                    f'tlog entry payload hash {got!r} does not match '
+                    f'the signed attestation')
+    elif kind in ('hashedrekord', 'rekord'):
+        sig_content = (spec.get('signature') or {}).get('content', '')
+        try:
+            body_sig = base64.b64decode(sig_content)
+        except Exception as e:  # noqa: BLE001
+            raise VerificationError(
+                f'undecodable tlog signature: {e}') from e
+        if body_sig != signature:
+            raise VerificationError(
+                'tlog entry signature does not match the verified '
+                'signature')
+        if kind == 'hashedrekord':
+            want = hashlib.sha256(payload).hexdigest()
+            got = (data.get('hash') or {}).get('value', '')
+            if got.lower() != want:
+                raise VerificationError(
+                    f'tlog entry payload hash {got!r} does not match the '
+                    f'signed payload')
+        else:
+            try:
+                content = base64.b64decode(data.get('content', ''))
+            except Exception as e:  # noqa: BLE001
+                raise VerificationError(
+                    f'undecodable tlog entry content: {e}') from e
+            if content != payload:
+                raise VerificationError(
+                    'tlog entry content does not match the signed payload')
+    else:
+        raise VerificationError(f'unsupported tlog entry kind {kind!r}')
+    it = pl.get('integratedTime')
+    if not isinstance(it, int):
+        raise VerificationError('tlog entry has no integratedTime')
+    return it
+
+
+def _check_cert_expiry_at(leaf, integrated_time: int) -> None:
+    """cosign CheckExpiry: the Rekor inclusion time must fall inside the
+    signing certificate's validity window."""
+    from datetime import datetime, timezone
+    at = datetime.fromtimestamp(integrated_time, tz=timezone.utc)
+    not_before = getattr(leaf, 'not_valid_before_utc', None) or \
+        leaf.not_valid_before.replace(tzinfo=timezone.utc)
+    not_after = getattr(leaf, 'not_valid_after_utc', None) or \
+        leaf.not_valid_after.replace(tzinfo=timezone.utc)
+    if at < not_before or at > not_after:
+        raise VerificationError(
+            f'tlog integratedTime {at.isoformat()} outside certificate '
+            f'validity [{not_before.isoformat()}, {not_after.isoformat()}]')
 
 
 def _decode_entry(entry: dict) -> Tuple[bytes, bytes]:
@@ -266,7 +419,11 @@ def _decode_entry(entry: dict) -> Tuple[bytes, bytes]:
 def _verify_entry(sig: dict, digest: str, opts: Options) -> None:
     """Cryptographically verify one stored signature entry."""
     payload, signature = _decode_entry(sig)
-    _verify_crypto_sig(sig, payload, signature, opts)
+    leaf = _verify_crypto_sig(sig, payload, signature, opts)
+    if opts.tlog_required():
+        integrated_time = _verify_tlog(sig, payload, signature, opts)
+        if leaf is not None:
+            _check_cert_expiry_at(leaf, integrated_time)
     _check_payload(payload, digest, opts)
 
 
@@ -328,7 +485,11 @@ def fetch_attestations(rclient, opts: Options) -> Response:
         if _is_crypto_entry(att):
             try:
                 payload, signature = _decode_entry(att)
-                _verify_crypto_sig(att, payload, signature, opts)
+                leaf = _verify_crypto_sig(att, payload, signature, opts)
+                if opts.tlog_required():
+                    it = _verify_tlog(att, payload, signature, opts)
+                    if leaf is not None:
+                        _check_cert_expiry_at(leaf, it)
                 statements.append(json.loads(payload))
             except VerificationError:
                 pass
@@ -389,3 +550,48 @@ def signature_entry(private_key, payload: bytes, cert_pem: str = '',
     if chain_pem:
         entry['chain'] = chain_pem
     return entry
+
+
+def make_bundle(rekor_private_key, payload: bytes, signature: bytes,
+                log_index: int = 1, integrated_time: Optional[int] = None,
+                log_id: str = 'c0ffee', kind: str = 'hashedrekord') -> dict:
+    """The offline Rekor bundle ``cosign sign`` attaches to a signature
+    (test fixtures / local signing — the produce side of what
+    ``_verify_tlog`` checks)."""
+    import hashlib
+    import time as _time
+    if integrated_time is None:
+        integrated_time = int(_time.time())
+    if kind == 'hashedrekord':
+        spec = {
+            'data': {'hash': {
+                'algorithm': 'sha256',
+                'value': hashlib.sha256(payload).hexdigest()}},
+            'signature': {'content': base64.b64encode(signature).decode()},
+        }
+    elif kind in ('intoto', 'dsse'):
+        spec = {
+            'content': {'hash': {
+                'algorithm': 'sha256',
+                'value': hashlib.sha256(payload).hexdigest()}},
+        }
+    else:  # rekord
+        spec = {
+            'data': {'content': base64.b64encode(payload).decode()},
+            'signature': {'content': base64.b64encode(signature).decode()},
+        }
+    body = base64.b64encode(json.dumps({
+        'apiVersion': '0.0.1', 'kind': kind, 'spec': spec,
+    }, sort_keys=True, separators=(',', ':')).encode()).decode()
+    bundle_payload = {
+        'body': body,
+        'integratedTime': integrated_time,
+        'logID': log_id,
+        'logIndex': log_index,
+    }
+    set_sig = sign_payload(rekor_private_key,
+                           canonical_tlog_payload(bundle_payload))
+    return {
+        'SignedEntryTimestamp': base64.b64encode(set_sig).decode(),
+        'Payload': bundle_payload,
+    }

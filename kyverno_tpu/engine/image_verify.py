@@ -396,6 +396,10 @@ class ImageVerifier:
         keys = attestor.get('keys') or {}
         keyless = attestor.get('keyless') or {}
         certs = attestor.get('certificates') or {}
+        # every attestor flavor may carry a rekor block
+        # (image_verification_types.go:149,173,181); nil → not checked
+        rekor = keys.get('rekor') or certs.get('rekor') or \
+            keyless.get('rekor') or {}
         return Options(
             image_ref=image,
             key=(keys.get('publicKeys') or '').strip(),
@@ -407,7 +411,9 @@ class ImageVerifier:
             annotations=attestor.get('annotations') or {},
             repository=(attestor.get('repository')
                         or image_verify.get('repository', '')),
-            rekor_url=(keyless.get('rekor') or {}).get('url', ''),
+            rekor_url=rekor.get('url', ''),
+            rekor_pubkey=rekor.get('pubkey', ''),
+            ignore_tlog=bool(rekor.get('ignoreTlog', False)),
             predicate_type=(attestation or {}).get('predicateType', ''),
             fetch_attestations=attestation is not None,
         )

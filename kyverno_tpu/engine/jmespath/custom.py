@@ -314,16 +314,33 @@ def _fn_multiply(ip, args):
     raise _err('multiply', 'types mismatch')
 
 
+def _quo_round_down(num: Fraction, den: Fraction, scale: int) -> Fraction:
+    """Reference quantity division (arithmetic.go:197 Quantity.Divide):
+    inf.Dec QuoRound to ``scale`` (max of the operands' AsDec scales —
+    NEGATIVE for decimal-SI suffixes, see Quantity.inf_scale), RoundDown
+    (truncation toward zero, the java-style DOWN rounder)."""
+    step = Fraction(10) ** scale
+    trunc = int(num / den * step)  # Fraction.__int__ truncates toward 0
+    return Fraction(trunc) / step
+
+
 def _fn_divide(ip, args):
+    from ...utils.quantity import _fraction_scale
     t1, v1, t2, v2 = _parse_operands('divide', args)
     if t1 == _QUANTITY and t2 == _QUANTITY:
         if v2.value == 0:
             raise _err('divide', 'Zero divisor passed')
-        return float(v1.value / v2.value)
+        scale = max(v1.inf_scale(), v2.inf_scale())
+        return float(_quo_round_down(v1.value, v2.value, scale))
     if t1 == _QUANTITY and t2 == _SCALAR:
         if v2 == 0:
             raise _err('divide', 'Zero divisor passed')
-        return _format_quantity(v1.value / Fraction(str(v2)), _is_binary(v1))
+        # the reference reparses the scalar as a quantity ('%v' of the
+        # float), whose scale is its decimal-digit count
+        f2 = Fraction(str(v2))
+        scale = max(v1.inf_scale(), _fraction_scale(f2))
+        return _format_quantity(
+            _quo_round_down(v1.value, f2, scale), _is_binary(v1))
     if t1 == _DURATION and t2 == _DURATION:
         if v2 == 0:
             raise _err('divide', 'Undefined quotient')

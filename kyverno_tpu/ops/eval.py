@@ -1269,7 +1269,8 @@ def _aot_key(fingerprint: str, packed: Dict[str, Any]) -> Optional[str]:
         if len(jax.local_devices(backend=backend)) != 1:
             return None
         payload = repr((_AOT_VERSION, _source_digest(), jax.__version__,
-                        jax.lib.__version__, platform, fingerprint, sig))
+                        jax.lib.__version__, platform, fingerprint, sig,
+                        os.environ.get('KTPU_FDET_K', '32')))
         return hashlib.sha256(payload.encode()).hexdigest()[:32]
     except Exception:  # noqa: BLE001 - cache is an optimization only
         return None
@@ -1769,22 +1770,66 @@ def build_evaluator(cps: CompiledPolicySet):
             (arr.shape[1] for name, arr in sorted(t.items())
              if name.endswith('_tag') and arr.ndim >= 2
              and name[0] in 'sa'), 0)
-        cols, dets, fds = [], [], []
+        # whole-program dedup: replicated/near-duplicate policies (the
+        # common case in large real policy sets — and the 1k-policy
+        # admission benchmark) compile identical status trees.  Each
+        # unique tree is traced ONCE and duplicate programs become a
+        # device-side column gather, collapsing both trace time and the
+        # XLA graph from O(policies) to O(unique rules).
+        uniq_idx: List[int] = []
+        uniq_results: List[Tuple[Any, Any, Any, List[Any]]] = []
+        memo: Dict[Any, int] = {}
         for prog in cps.programs:
-            s, d, fd = eval_status(t, prog.status, 0)
-            cols.append(s)
-            dets.append(d)
-            fds.append(fd)
-        if not cols:
+            try:
+                u = memo.get(prog.status)
+                memo_key = prog.status
+            except TypeError:  # unhashable operand somewhere in the tree
+                u = None
+                memo_key = None
+            if u is None:
+                aux_before = len(aux_acc)
+                s, d, fd = eval_status(t, prog.status, 0)
+                aux_slice = list(aux_acc[aux_before:])
+                del aux_acc[aux_before:]
+                u = len(uniq_results)
+                uniq_results.append((s, d, fd, aux_slice))
+                if memo_key is not None:
+                    memo[memo_key] = u
+            uniq_idx.append(u)
+        if not uniq_results:
             n = t[next(iter(t))].shape[0] if t else 0
             z = jnp.zeros((n, 0), jnp.int8)
             return z, z, jnp.zeros((n, 0), jnp.int32)
-        fdet = jnp.stack(fds, axis=1)
-        if aux_acc:
-            # anyPattern child channels live past the P main columns
+        s_u = jnp.stack([r[0] for r in uniq_results], axis=1)
+        d_u = jnp.stack([r[1] for r in uniq_results], axis=1)
+        fd_u = jnp.stack([r[2] for r in uniq_results], axis=1)
+        pid = np.asarray(uniq_idx)
+        if len(uniq_results) == len(cps.programs):
+            statuses, details, fd_main = s_u, d_u, fd_u
+        else:
+            statuses = s_u[:, pid]
+            details = d_u[:, pid]
+            fd_main = fd_u[:, pid]
+        # anyPattern child channels live past the P main columns; the
+        # static any_meta bases were assigned in program order, so map
+        # each program's channels onto its unique's aux columns
+        uniq_aux_base: List[int] = []
+        uniq_aux_arrays: List[Any] = []
+        for r in uniq_results:
+            uniq_aux_base.append(len(uniq_aux_arrays))
+            uniq_aux_arrays.extend(r[3])
+        aux_index: List[int] = []
+        for j in sorted(any_meta, key=lambda jj: any_meta[jj][0]):
+            _base, cnt = any_meta[j]
+            ub = uniq_aux_base[uniq_idx[j]]
+            aux_index.extend(range(ub, ub + cnt))
+        if aux_index:
+            aux_u = jnp.stack(uniq_aux_arrays, axis=1)
             fdet = jnp.concatenate(
-                [fdet, jnp.stack(list(aux_acc), axis=1)], axis=1)
-        return jnp.stack(cols, axis=1), jnp.stack(dets, axis=1), fdet
+                [fd_main, aux_u[:, np.asarray(aux_index)]], axis=1)
+        else:
+            fdet = fd_main
+        return statuses, details, fdet
 
     layout_holder: Dict[str, Any] = {'layout': None}
 
@@ -1813,7 +1858,10 @@ def build_evaluator(cps: CompiledPolicySet):
                                           (s.shape[0], cnt)))
         rel = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
         c = fdet.shape[1]
-        k = min(fdet_k, c)
+        # budget scales with the program count: a huge (e.g. replicated)
+        # policy set legitimately fails hundreds of matched rules per
+        # resource, and overflow degrades to host materialization
+        k = min(max(fdet_k, c // 3), c)
         col_idx = jnp.arange(c, dtype=jnp.int32)
         keys = jnp.where(rel, col_idx, jnp.int32(c))
         order = jnp.sort(keys, axis=1)[:, :k]

@@ -29,10 +29,28 @@ _DECIMAL = {
     'E': Fraction(10 ** 18),
 }
 
+_DEC_EXP = {'n': -9, 'u': -6, 'm': -3, '': 0, 'k': 3, 'M': 6,
+            'G': 9, 'T': 12, 'P': 15, 'E': 18}
+
 _QTY_RE = re.compile(
     r'^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)'
     r'(?P<suffix>(?:[eE][+-]?\d+)|(?:Ki|Mi|Gi|Ti|Pi|Ei)|[numkMGTPE]?)$'
 )
+
+
+def _fraction_scale(v: Fraction) -> int:
+    """Decimal digits after the point of the exact value (denominators
+    here are always 2^a·5^b products)."""
+    d = v.denominator
+    twos = 0
+    while d % 2 == 0:
+        d //= 2
+        twos += 1
+    fives = 0
+    while d % 5 == 0:
+        d //= 5
+        fives += 1
+    return max(twos, fives)
 
 
 class Quantity:
@@ -64,6 +82,25 @@ class Quantity:
         else:  # pragma: no cover - regex prevents this
             raise ValueError(f"unknown suffix {suffix!r}")
         return cls(sign * num * mult, suffix)
+
+    def inf_scale(self) -> int:
+        """``resource.Quantity.AsDec().Scale()`` of the Go reference:
+        the int64Amount keeps (mantissa-digits, base-10 exponent), and
+        AsDec is ``inf.NewDec(value, -scale)`` — so decimal suffixes
+        yield NEGATIVE inf scales ('3G' → -9) and sub-unit forms
+        positive ones ('100m' → 3).  Binary-suffix quantities parse to
+        plain integers (scale from any fractional remainder only).
+        Drives the QuoRound truncation scale of quantity division
+        (reference: pkg/engine/jmespath/arithmetic.go:197)."""
+        sfx = self.suffix
+        if sfx in _BINARY:
+            return _fraction_scale(self.value)
+        if sfx and sfx[0] in 'eE':
+            e = int(sfx[1:])
+        else:
+            e = _DEC_EXP[sfx]
+        mantissa = self.value / Fraction(10) ** e
+        return _fraction_scale(mantissa) - e
 
     def cmp(self, other: 'Quantity') -> int:
         if self.value < other.value:

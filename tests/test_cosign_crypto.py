@@ -365,3 +365,183 @@ class TestAttestationCrypto:
         resp = cosign.fetch_attestations(
             r, cosign.Options(REF, key=pem_public(key)))
         assert resp.statements == []
+
+
+class TestRekorTlog:
+    """Offline Rekor bundle verification (reference engages the cosign
+    library's tlog path through pkg/cosign/cosign.go:204; the CRD says
+    'If the value is nil, Rekor is not checked' —
+    image_verification_types.go:149)."""
+
+    def _signed_entry(self, key, rekor_key, kind='hashedrekord',
+                      integrated_time=None):
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(key, payload)
+        entry['bundle'] = cosign.make_bundle(
+            rekor_key, payload, base64.b64decode(entry['signature']),
+            kind=kind, integrated_time=integrated_time)
+        return entry
+
+    def test_valid_bundle_accepts(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        r.add_signature(REF, self._signed_entry(key, rekor))
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+        assert resp.digest == DIGEST
+
+    def test_valid_rekord_bundle_accepts(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        r.add_signature(REF, self._signed_entry(key, rekor, kind='rekord'))
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+        assert resp.digest == DIGEST
+
+    def test_missing_bundle_rejects_when_rekor_configured(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        with pytest.raises(RegistryError, match='bundle'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+
+    def test_no_rekor_block_means_not_checked(self):
+        key = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        resp = cosign.verify_signature(
+            r, cosign.Options(REF, key=pem_public(key)))
+        assert resp.digest == DIGEST
+
+    def test_ignore_tlog_skips_bundle_requirement(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        r.add_signature(REF, cosign.signature_entry(key, payload))
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, key=pem_public(key), rekor_pubkey=pem_public(rekor),
+            ignore_tlog=True))
+        assert resp.digest == DIGEST
+
+    def test_set_signed_by_wrong_key_rejects(self):
+        key, rekor, impostor = ec_key(), ec_key(), ec_key()
+        r = registry()
+        r.add_signature(REF, self._signed_entry(key, impostor))
+        with pytest.raises(RegistryError, match='signature verification'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+
+    def test_tampered_set_payload_rejects(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        entry = self._signed_entry(key, rekor)
+        entry['bundle']['Payload']['logIndex'] += 1
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+
+    def test_body_hash_mismatch_rejects(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(key, payload)
+        # bundle built over a DIFFERENT payload: SET verifies but the
+        # entry does not describe this signature's payload
+        other = cosign.make_payload(REF, 'sha256:' + 'cd' * 32)
+        other_entry = cosign.signature_entry(key, other)
+        entry['bundle'] = cosign.make_bundle(
+            rekor, other, base64.b64decode(other_entry['signature']))
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+
+    def test_bundle_signature_mismatch_rejects(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(key, payload)
+        # entry hash matches the payload but the logged signature bytes
+        # belong to a different signing event
+        entry['bundle'] = cosign.make_bundle(
+            rekor, payload, cosign.sign_payload(ec_key(), payload))
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError, match='does not match'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, key=pem_public(key), rekor_pubkey=pem_public(rekor)))
+
+    def test_keyless_integrated_time_outside_cert_validity_rejects(self):
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        rekor = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(leaf_key, payload,
+                                       cert_pem=pem_cert(leaf_cert))
+        # leaf valid [2026-01-01, +365d]; integrate before the window
+        before = int(datetime.datetime(
+            2025, 6, 1, tzinfo=datetime.timezone.utc).timestamp())
+        entry['bundle'] = cosign.make_bundle(
+            rekor, payload, base64.b64decode(entry['signature']),
+            integrated_time=before)
+        r.add_signature(REF, entry)
+        with pytest.raises(RegistryError, match='validity'):
+            cosign.verify_signature(r, cosign.Options(
+                REF, roots=pem_cert(ca_cert),
+                rekor_pubkey=pem_public(rekor)))
+
+    def test_keyless_integrated_time_inside_cert_validity_accepts(self):
+        ca_key, ca_cert = make_ca()
+        leaf_key, leaf_cert = make_leaf(ca_key, ca_cert)
+        rekor = ec_key()
+        r = registry()
+        payload = cosign.make_payload(REF, DIGEST)
+        entry = cosign.signature_entry(leaf_key, payload,
+                                       cert_pem=pem_cert(leaf_cert))
+        inside = int(datetime.datetime(
+            2026, 6, 1, tzinfo=datetime.timezone.utc).timestamp())
+        entry['bundle'] = cosign.make_bundle(
+            rekor, payload, base64.b64decode(entry['signature']),
+            integrated_time=inside)
+        r.add_signature(REF, entry)
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, roots=pem_cert(ca_cert), rekor_pubkey=pem_public(rekor)))
+        assert resp.digest == DIGEST
+
+    def test_env_var_rekor_key(self, monkeypatch):
+        key, rekor = ec_key(), ec_key()
+        monkeypatch.setenv('SIGSTORE_REKOR_PUBLIC_KEY', pem_public(rekor))
+        r = registry()
+        r.add_signature(REF, self._signed_entry(key, rekor))
+        resp = cosign.verify_signature(r, cosign.Options(
+            REF, key=pem_public(key), rekor_url='https://rekor.internal'))
+        assert resp.digest == DIGEST
+
+    def test_attestations_respect_tlog(self):
+        key, rekor = ec_key(), ec_key()
+        r = registry()
+        statement = {'_type': 'https://in-toto.io/Statement/v0.1',
+                     'predicateType': 'https://example.com/provenance',
+                     'predicate': {'ok': True}}
+        import json as _json
+        payload = _json.dumps(statement).encode()
+        entry = cosign.signature_entry(key, payload)
+        r.add_attestation(REF, entry)
+        # no bundle + rekor configured -> statement filtered out
+        resp = cosign.fetch_attestations(r, cosign.Options(
+            REF, key=pem_public(key), rekor_pubkey=pem_public(rekor),
+            fetch_attestations=True))
+        assert resp.statements == []
+        entry2 = dict(entry)
+        entry2['bundle'] = cosign.make_bundle(
+            rekor, payload, base64.b64decode(entry['signature']))
+        r2 = registry()
+        r2.add_attestation(REF, entry2)
+        resp = cosign.fetch_attestations(r2, cosign.Options(
+            REF, key=pem_public(key), rekor_pubkey=pem_public(rekor),
+            fetch_attestations=True))
+        assert len(resp.statements) == 1

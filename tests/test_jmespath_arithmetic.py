@@ -1,0 +1,179 @@
+"""The reference's arithmetic cross-type case matrix, ported verbatim
+from /root/reference/pkg/engine/jmespath/functions_test.go
+(Test_Add:540, Test_Subtract:639, Test_Multiply:738, Test_Divide:837,
+Test_Modulo:975) per the operator semantics in
+pkg/engine/jmespath/arithmetic.go: quantity × duration × scalar for
+add / subtract / multiply / divide / modulo, including the ambiguous
+'13'-as-quantity parses and every divide/modulo-by-zero form.
+
+Each case is (expression, expected) where expected is a float (scalar
+result), a string (canonical quantity/duration form), or ERR.
+"""
+
+import pytest
+
+from kyverno_tpu.engine import jmespath as jp
+
+ERR = object()
+
+ADD = [
+    # Scalar
+    ("add(`12`, `13`)", 25.0),
+    ("add('12', '13s')", ERR),
+    ("add(`12`, '13Ki')", ERR),
+    ("add(`12`, '13')", ERR),
+    # Quantity
+    ("add('12Ki', '13Ki')", "25Ki"),
+    ("add('12Ki', '13')", "12301"),
+    ("add('12Ki', '13s')", ERR),
+    ("add('12Ki', `13`)", ERR),
+    # Duration
+    ("add('12s', '13s')", "25s"),
+    ("add('12s', '13')", ERR),
+    ("add('12s', '13Ki')", ERR),
+]
+
+SUBTRACT = [
+    # Scalar
+    ("subtract(`12`, `13`)", -1.0),
+    ("subtract('12', '13s')", ERR),
+    ("subtract(`12`, '13Ki')", ERR),
+    ("subtract(`12`, '13')", ERR),
+    # Quantity
+    ("subtract('12Ki', '13Ki')", "-1Ki"),
+    ("subtract('12Ki', '13')", "12275"),
+    ("subtract('12Ki', '13s')", ERR),
+    ("subtract('12Ki', `13`)", ERR),
+    # Duration
+    ("subtract('12s', '13s')", "-1s"),
+    ("subtract('12s', '13')", ERR),
+    ("subtract('12s', '13Ki')", ERR),
+]
+
+MULTIPLY = [
+    # Quantity
+    ("multiply('12Ki', `2`)", "24Ki"),
+    ("multiply('12Ki', '12Ki')", ERR),
+    ("multiply('12Ki', '12')", ERR),
+    ("multiply('12Ki', '12s')", ERR),
+    # Duration
+    ("multiply('12s', `2`)", "24s"),
+    ("multiply('12s', '12Ki')", ERR),
+    ("multiply('12s', '12')", ERR),
+    ("multiply('12s', '12s')", ERR),
+    # Scalar
+    ("multiply(`2.5`, `2.5`)", 6.25),
+    ("multiply(`2.5`, '12Ki')", "30Ki"),
+    ("multiply(`2.5`, '12')", "30"),
+    ("multiply(`2.5`, '40s')", "1m40s"),
+]
+
+DIVIDE = [
+    # Quantity
+    ("divide('12Ki', `3`)", "4Ki"),
+    ("divide('12Ki', '2Ki')", 6.0),
+    ("divide('12Ki', '200')", 61.0),
+    ("divide('12Ki', '2s')", ERR),
+    # Duration
+    ("divide('12s', `3`)", "4s"),
+    ("divide('12s', '5s')", 2.4),
+    ("divide('12s', '4Ki')", ERR),
+    ("divide('12s', '4')", ERR),
+    # Scalar
+    ("divide(`14`, `3`)", 4.666666666666667),
+    ("divide(`14`, '5s')", ERR),
+    ("divide(`14`, '5Ki')", ERR),
+    ("divide(`14`, '5')", ERR),
+    # Divide by 0
+    ("divide(`14`, `0`)", ERR),
+    ("divide('4Ki', `0`)", ERR),
+    ("divide('4Ki', '0Ki')", ERR),
+    ("divide('4', `0`)", ERR),
+    ("divide('4', '0')", ERR),
+    ("divide('4s', `0`)", ERR),
+    ("divide('4s', '0s')", ERR),
+]
+
+MODULO = [
+    # Quantity
+    ("modulo('12', '13s')", ERR),
+    ("modulo('12Ki', '13s')", ERR),
+    ("modulo('12Ki', `13`)", ERR),
+    ("modulo('12Ki', '5Ki')", "2Ki"),
+    # Duration
+    ("modulo('13s', '12')", ERR),
+    ("modulo('13s', '12Ki')", ERR),
+    ("modulo('13s', '2s')", "1s"),
+    ("modulo('13s', `2`)", ERR),
+    # Scalar
+    ("modulo(`13`, '12')", ERR),
+    ("modulo(`13`, '12Ki')", ERR),
+    ("modulo(`13`, '5s')", ERR),
+    ("modulo(`13`, `5`)", 3.0),
+    # Modulo by 0
+    ("modulo(`14`, `0`)", ERR),
+    ("modulo('4Ki', `0`)", ERR),
+    ("modulo('4Ki', '0Ki')", ERR),
+    ("modulo('4', `0`)", ERR),
+    ("modulo('4', '0')", ERR),
+    ("modulo('4s', `0`)", ERR),
+    ("modulo('4s', '0s')", ERR),
+]
+
+
+def run_matrix(cases):
+    for expr, expected in cases:
+        if expected is ERR:
+            with pytest.raises(Exception):
+                jp.search(expr, "")
+            continue
+        result = jp.search(expr, "")
+        if isinstance(expected, float):
+            assert isinstance(result, float), \
+                f'{expr}: expected float, got {type(result).__name__} {result!r}'
+            assert result == expected, f'{expr}: {result!r} != {expected!r}'
+        else:
+            assert isinstance(result, str), \
+                f'{expr}: expected str, got {type(result).__name__} {result!r}'
+            assert result == expected, f'{expr}: {result!r} != {expected!r}'
+
+
+class TestArithmeticMatrix:
+    def test_add(self):
+        run_matrix(ADD)
+
+    def test_subtract(self):
+        run_matrix(SUBTRACT)
+
+    def test_multiply(self):
+        run_matrix(MULTIPLY)
+
+    def test_divide(self):
+        run_matrix(DIVIDE)
+
+    def test_modulo(self):
+        run_matrix(MODULO)
+
+
+class TestDivideScaleQuirks:
+    """inf.Dec QuoRound truncation uses the quantities' AsDec scales —
+    NEGATIVE for decimal-SI suffixes ('3G' is inf.NewDec(3, -9)), so
+    division quantizes to the coarser operand's unit
+    (arithmetic.go:197 Quantity.Divide)."""
+
+    def test_milli_scale_truncation(self):
+        assert jp.search("divide('100m', '3')", "") == 0.033
+        assert jp.search("divide('2500m', '3')", "") == 0.833
+
+    def test_decimal_suffix_negative_scale(self):
+        # scale -9: the quotient truncates to multiples of 1e9, so BOTH
+        # quotients collapse to 0 (a faithful reference quirk —
+        # inf.Dec.QuoRound at the AsDec scale of the coarser operand)
+        assert jp.search("divide('3G', '2G')", "") == 0.0
+        assert jp.search("divide('4G', '2G')", "") == 0.0
+        # a suffix-less divisor (AsDec scale 0) restores resolution
+        assert jp.search("divide('4G', '2000000000')", "") == 2.0
+
+    def test_mixed_scales(self):
+        # '3G' scale -9, '200' scale 0 -> max 0 -> plain truncation
+        assert jp.search("divide('3G', '200')", "") == 15000000.0
