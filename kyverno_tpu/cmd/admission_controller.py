@@ -6,6 +6,7 @@ leader-only reconcilers (webhook configurations, lease watchdog)."""
 from __future__ import annotations
 
 import tempfile
+import threading
 from typing import List, Optional
 
 from ..api.policy import Policy
@@ -36,9 +37,11 @@ class AdmissionController:
             self._key_tmp.write(key)
             self._key_tmp.flush()
             certfile, keyfile = self._cert_tmp.name, self._key_tmp.name
+        self._audit_threads: List[threading.Thread] = []
         self.handlers = ResourceHandlers(
             self.cache, configuration=setup.configuration,
-            ur_sink=self._create_ur)
+            ur_sink=self._create_ur, audit_sink=self._audit,
+            client=setup.client)
         # CRD schema ingestion feeding the mutation schema checks
         # (reference: pkg/controllers/openapi/controller.go:148)
         from ..controllers.openapi import OpenAPIController
@@ -66,16 +69,57 @@ class AdmissionController:
         UpdateRequestGenerator(self.setup.client).apply(
             dict(ur_spec, requestType=ur_spec.get('type', 'generate')))
 
+    def _audit(self, request: dict, _enforce_responses) -> None:
+        """Audit-report hand-off: runs on a worker thread like the
+        reference's goroutine (validation.go:182 handleAudit) so the
+        admission response never waits on the audit engine pass or the
+        report CR write."""
+        if request.get('operation') == 'DELETE':
+            return
+        t = threading.Thread(target=self._audit_sync, args=(request,),
+                             daemon=True, name='audit-report')
+        t.start()
+        self._audit_threads.append(t)
+        del self._audit_threads[:-32]  # drop handles of finished work
+
+    def flush_audits(self) -> None:
+        """Join outstanding audit threads (tests / graceful shutdown)."""
+        for t in list(self._audit_threads):
+            t.join(timeout=30)
+
+    def _audit_sync(self, request: dict) -> None:
+        """reference: validation.go:156 buildAuditResponses — the AUDIT
+        policy set produces per-request AdmissionReport CRs for the
+        reports controller to aggregate."""
+        resource = request.get('object') or {}
+        responses = self.handlers.audit_responses(request)
+        relevant = [r for r in responses if r.policy_response.rules]
+        if not relevant:
+            return
+        from ..dclient.client import AlreadyExistsError
+        from ..reports.types import build_admission_report
+        report = build_admission_report(resource, request, *relevant)
+        ns = (resource.get('metadata') or {}).get('namespace', '')
+        try:
+            self.setup.client.create_resource(
+                'kyverno.io/v1alpha2', report['kind'], ns, report)
+        except AlreadyExistsError:
+            pass  # duplicate request uid: the first report stands
+
     def sync_policies(self) -> List[Policy]:
         """Refresh the cache from stored Policy CRs (informer-driven in
         the reference: pkg/controllers/policycache/controller.go:133)."""
         docs = []
-        for kind in ('ClusterPolicy', 'Policy'):
-            try:
-                docs += self.setup.client.list_resource(
-                    'kyverno.io/v1', kind, '', None)
-            except Exception:  # noqa: BLE001
-                continue
+        # policy CRDs are served at multiple versions (v1 is the
+        # storage version; v2beta1 manifests are conversion-identical
+        # for the fields the engine reads)
+        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
+            for kind in ('ClusterPolicy', 'Policy'):
+                try:
+                    docs += self.setup.client.list_resource(
+                        api_version, kind, '', None)
+                except Exception:  # noqa: BLE001
+                    continue
         policies = [Policy(d) for d in docs]
         self.cache.warm_up(policies)
         return policies

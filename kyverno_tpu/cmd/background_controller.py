@@ -16,9 +16,12 @@ from .internal import Setup, base_parser
 class BackgroundController:
     def __init__(self, setup: Setup):
         self.setup = setup
+        from ..engine.apicall import make_context_loader
         from ..engine.engine import Engine
+        engine = Engine(context_loader=make_context_loader(
+            dclient=setup.client))
         self.ur_controller = UpdateRequestController(
-            setup.client, Engine(),
+            setup.client, engine,
             policy_getter=self._get_policy)
         self.policy_controller = PolicyController(setup.client)
         self._seen_policies: dict = {}
@@ -26,13 +29,14 @@ class BackgroundController:
     def _get_policy(self, key: str):
         from ..api.policy import Policy
         name = key.split('/')[-1]
-        for kind in ('ClusterPolicy', 'Policy'):
-            try:
-                doc = self.setup.client.get_resource(
-                    'kyverno.io/v1', kind, '', name)
-                return Policy(doc)
-            except Exception:  # noqa: BLE001
-                continue
+        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
+            for kind in ('ClusterPolicy', 'Policy'):
+                try:
+                    doc = self.setup.client.get_resource(
+                        api_version, kind, '', name)
+                    return Policy(doc)
+                except Exception:  # noqa: BLE001
+                    continue
         return None
 
     def tick(self) -> None:

@@ -1,0 +1,1 @@
+"""Conformance harnesses replaying the reference's test corpora."""

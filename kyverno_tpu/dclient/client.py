@@ -116,6 +116,8 @@ class FakeClient:
                 return obj
             self._rv += 1
             meta['resourceVersion'] = str(self._rv)
+            # the API server assigns the uid on create
+            meta.setdefault('uid', f'uid-{self._rv}')
             self._store[key] = obj
             out = copy.deepcopy(obj)
         self._notify('ADDED', obj)
@@ -202,6 +204,68 @@ class FakeClient:
         out.sort(key=lambda o: ((o.get('metadata') or {}).get('namespace', ''),
                                 (o.get('metadata') or {}).get('name', '')))
         return out
+
+    # -- raw REST access -----------------------------------------------------
+
+    def raw_abs_path(self, path: str) -> bytes:
+        """Serve a GET of a Kubernetes REST path from the store — the
+        fake analogue of dclient.RawAbsPath (client.go:22), which the
+        engine's APICall context entries use."""
+        import json
+        import re
+        from urllib.parse import parse_qs, urlsplit
+        split = urlsplit(path)
+        p = split.path
+        m = re.fullmatch(
+            r'/(?:api/(?P<core>v1)|apis/(?P<group>[^/]+/[^/]+))'
+            r'(?:/namespaces/(?P<ns>[^/]+))?'
+            r'/(?P<plural>[^/?]+)'
+            r'(?:/(?P<name>[^/?]+))?', p)
+        if not m:
+            raise NotFoundError(f'path {path!r} not found')
+        av = m.group('core') or m.group('group')
+        kind = self._kind_for_plural(m.group('plural'))
+        if kind is None:
+            raise NotFoundError(f'resource {m.group("plural")!r} unknown')
+        ns = m.group('ns') or ''
+        name = m.group('name') or ''
+        if name:
+            obj = self.get_resource(av, kind, ns, name)
+            return json.dumps(obj).encode()
+        selector = None
+        sel = {k: v[0] for k, v in parse_qs(split.query).items()}.get(
+            'labelSelector', '')
+        if sel:
+            from .fakeserver import _selector_from_query
+            selector = _selector_from_query(sel)
+        items = self.list_resource(av, kind, ns, selector)
+        return json.dumps({'kind': f'{kind}List', 'apiVersion': av,
+                           'items': items}).encode()
+
+    _WELL_KNOWN_PLURALS = {
+        'pods': 'Pod', 'namespaces': 'Namespace',
+        'configmaps': 'ConfigMap', 'secrets': 'Secret',
+        'services': 'Service', 'deployments': 'Deployment',
+        'networkpolicies': 'NetworkPolicy',
+        'clusterpolicies': 'ClusterPolicy', 'policies': 'Policy',
+        'updaterequests': 'UpdateRequest',
+        'policyreports': 'PolicyReport',
+        'clusterpolicyreports': 'ClusterPolicyReport',
+    }
+
+    def _kind_for_plural(self, plural: str) -> Optional[str]:
+        kind = self._WELL_KNOWN_PLURALS.get(plural)
+        if kind:
+            return kind
+        # fall back to naive pluralization over stored kinds
+        with self._lock:
+            kinds = {k for (_av, k, _ns, _n) in self._store}
+        for k in kinds:
+            low = k.lower()
+            if plural in (low + 's', low + 'es',
+                          low[:-1] + 'ies' if low.endswith('y') else ''):
+                return k
+        return None
 
     # -- namespace helpers ---------------------------------------------------
 

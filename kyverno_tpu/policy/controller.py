@@ -54,6 +54,41 @@ class PolicyController:
         policy = Policy(doc)
         with self._lock:
             self._policies.pop(self._key(policy), None)
+        # deleting a synchronize=true DATA generate policy deletes its
+        # downstream resources (reference: the UR cleanup path triggered
+        # by policy deletion — generate.go:848 deleteGeneratedResources;
+        # cloned downstream is preserved, generate.go:242).  The list is
+        # scoped to the rule's generated kind AND the per-rule label so
+        # a sibling clone rule's downstream is never swept.
+        from ..background.labels import (BACKGROUND_GEN_RULE_LABEL,
+                                         POLICY_NAME_LABEL)
+        for rule in policy.rules:
+            gen = rule.raw.get('generate') or {}
+            if not rule.has_generate() or not gen.get('synchronize'):
+                continue
+            if gen.get('clone') or gen.get('cloneList'):
+                continue
+            selector = {'matchLabels': {POLICY_NAME_LABEL: policy.name}}
+            try:
+                downstream = self.client.list_resource(
+                    gen.get('apiVersion', ''), gen.get('kind', ''), '',
+                    selector)
+            except Exception:  # noqa: BLE001 - kind not listable
+                continue
+            for obj in downstream:
+                meta = obj.get('metadata') or {}
+                labels = meta.get('labels') or {}
+                # the rule label is only stamped on generate-existing
+                # downstream; when present it must name THIS rule
+                stamped = labels.get(BACKGROUND_GEN_RULE_LABEL)
+                if stamped is not None and stamped != rule.name:
+                    continue
+                try:
+                    self.client.delete_resource(
+                        obj.get('apiVersion', ''), obj.get('kind', ''),
+                        meta.get('namespace', ''), meta.get('name', ''))
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
 
     @staticmethod
     def _key(policy: Policy) -> str:

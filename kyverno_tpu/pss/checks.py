@@ -27,6 +27,12 @@ class Check(NamedTuple):
     id: str
     level: str
     fn: Callable[[dict, dict], CheckResult]
+    # upstream checks carry one implementation per MinimumVersion, and
+    # the reference runs EVERY versioned variant regardless of the
+    # requested version (pkg/pss/evaluate.go:24 `for _, versionCheck :=
+    # range check.Versions` — no dedup), so a pod failing two variants
+    # reports the violation twice.  Empty → just ``fn``.
+    fns: tuple = ()
 
 
 OK = CheckResult(True)
@@ -398,6 +404,43 @@ def check_capabilities_restricted(meta: dict, spec: dict) -> CheckResult:
     return OK
 
 
+def _windows_exempt(fn: Callable[[dict, dict], CheckResult]
+                    ) -> Callable[[dict, dict], CheckResult]:
+    """The 1.25 variants skip linux-only checks for windows pods
+    (KEP-2802: pod.spec.os.name == 'windows')."""
+    def variant(meta: dict, spec: dict) -> CheckResult:
+        if (spec.get('os') or {}).get('name') == 'windows':
+            return OK
+        return fn(meta, spec)
+    return variant
+
+
+_SECCOMP_ANNOTATION_POD = 'seccomp.security.alpha.kubernetes.io/pod'
+_SECCOMP_ANNOTATION_PREFIX = 'container.seccomp.security.alpha.kubernetes.io/'
+
+
+def check_seccomp_baseline_1_0(meta: dict, spec: dict) -> CheckResult:
+    """The pre-1.19 annotation-based seccomp check
+    (pod-security-admission check_seccompProfile_baseline.go v1.0)."""
+    annotations = meta.get('annotations') or {}
+    forbidden = []
+    val = annotations.get(_SECCOMP_ANNOTATION_POD)
+    if val == 'unconfined':
+        forbidden.append(f'{_SECCOMP_ANNOTATION_POD}="{val}"')
+    for c in _containers(spec):
+        key = _SECCOMP_ANNOTATION_PREFIX + c.get('name', '')
+        val = annotations.get(key)
+        if val == 'unconfined':
+            forbidden.append(f'{key}="{val}"')
+    if forbidden:
+        return CheckResult(
+            False, 'seccompProfile',
+            f'forbidden '
+            f'{_pluralize("annotation", "annotations", len(forbidden))} '
+            f'{", ".join(forbidden)}')
+    return OK
+
+
 DEFAULT_CHECKS: List[Check] = [
     Check('hostNamespaces', LEVEL_BASELINE, check_host_namespaces),
     Check('privileged', LEVEL_BASELINE, check_privileged),
@@ -407,18 +450,25 @@ DEFAULT_CHECKS: List[Check] = [
     Check('appArmorProfile', LEVEL_BASELINE, check_app_armor),
     Check('seLinuxOptions', LEVEL_BASELINE, check_selinux_options),
     Check('procMount', LEVEL_BASELINE, check_proc_mount),
-    Check('seccompProfile_baseline', LEVEL_BASELINE, check_seccomp_baseline),
+    Check('seccompProfile_baseline', LEVEL_BASELINE, check_seccomp_baseline,
+          (check_seccomp_baseline_1_0, check_seccomp_baseline)),
     Check('sysctls', LEVEL_BASELINE, check_sysctls),
     Check('windowsHostProcess', LEVEL_BASELINE, check_windows_host_process),
     Check('restrictedVolumes', LEVEL_RESTRICTED, check_restricted_volumes),
     Check('allowPrivilegeEscalation', LEVEL_RESTRICTED,
-          check_allow_privilege_escalation),
+          check_allow_privilege_escalation,
+          (check_allow_privilege_escalation,
+           _windows_exempt(check_allow_privilege_escalation))),
     Check('runAsNonRoot', LEVEL_RESTRICTED, check_run_as_non_root),
     Check('runAsUser', LEVEL_RESTRICTED, check_run_as_user),
     Check('seccompProfile_restricted', LEVEL_RESTRICTED,
-          check_seccomp_restricted),
+          check_seccomp_restricted,
+          (check_seccomp_restricted,
+           _windows_exempt(check_seccomp_restricted))),
     Check('capabilities_restricted', LEVEL_RESTRICTED,
-          check_capabilities_restricted),
+          check_capabilities_restricted,
+          (check_capabilities_restricted,
+           _windows_exempt(check_capabilities_restricted))),
 ]
 
 

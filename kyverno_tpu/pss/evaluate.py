@@ -39,16 +39,21 @@ def evaluate_pss(level: str, pod: dict) -> List[dict]:
     for check in DEFAULT_CHECKS:
         if level == LEVEL_BASELINE and check.level != level:
             continue
-        result = check.fn(meta, spec)
-        if not result.allowed:
-            results.append({
-                'id': check.id,
-                'checkResult': {
-                    'allowed': False,
-                    'forbiddenReason': result.forbidden_reason,
-                    'forbiddenDetail': result.forbidden_detail,
-                },
-            })
+        # EVERY versioned variant runs, regardless of the requested
+        # version, and failing variants each append a result — the
+        # reference does not dedup (evaluate.go:24-35), so a pod
+        # failing two variants reports the violation twice
+        for variant in (check.fns or (check.fn,)):
+            result = variant(meta, spec)
+            if not result.allowed:
+                results.append({
+                    'id': check.id,
+                    'checkResult': {
+                        'allowed': False,
+                        'forbiddenReason': result.forbidden_reason,
+                        'forbiddenDetail': result.forbidden_detail,
+                    },
+                })
     return results
 
 
@@ -89,13 +94,19 @@ def _pod_with_matching_containers(exclude: dict, pod: dict):
 
 def _exempt(default_results: List[dict], exclude_results: List[dict],
             exclude: dict) -> List[dict]:
-    # reference: pkg/pss/evaluate.go:38 exemptKyvernoExclusion
-    by_id = {r['id']: r for r in default_results}
+    # reference: pkg/pss/evaluate.go:38 exemptKyvernoExclusion — the
+    # results round-trip through a map keyed by check ID, so duplicate
+    # versioned-variant results COLLAPSE whenever a rule has excludes
+    # (last one wins); insertion order stands in for Go's random map
+    # iteration
+    by_id = {}
+    for r in default_results:
+        by_id[r['id']] = r
     check_ids = PSS_CONTROLS_TO_CHECK_ID.get(exclude.get('controlName', ''), [])
     for ex in exclude_results:
         if ex['id'] in check_ids:
             by_id.pop(ex['id'], None)
-    return [r for r in default_results if r['id'] in by_id]
+    return list(by_id.values())
 
 
 def format_checks_print(checks: List[dict]) -> str:

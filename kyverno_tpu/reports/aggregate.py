@@ -38,10 +38,11 @@ class AggregateController:
         self.policy_lister = policy_lister or self._list_policies
 
     def _list_policies(self) -> List[Policy]:
-        out = [Policy(p) for p in self.client.list_resource(
-            'kyverno.io/v1', 'ClusterPolicy')]
-        out += [Policy(p) for p in self.client.list_resource(
-            'kyverno.io/v1', 'Policy')]
+        out = []
+        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
+            for kind in ('ClusterPolicy', 'Policy'):
+                out += [Policy(p) for p in self.client.list_resource(
+                    api_version, kind)]
         return out
 
     def _create_policy_map(self) -> Dict[str, Tuple[Policy, Set[str]]]:

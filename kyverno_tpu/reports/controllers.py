@@ -124,6 +124,10 @@ class BackgroundScanController:
                  engine: Optional[Engine] = None):
         self.client = client
         self.cache = cache or MetadataCache()
+        if engine is None and client is not None:
+            from ..engine.apicall import make_context_loader
+            engine = Engine(context_loader=make_context_loader(
+                dclient=client))
         self.engine = engine or Engine()
         self._lock = threading.Lock()
         self._pending: Set[str] = set()

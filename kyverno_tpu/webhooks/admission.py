@@ -123,4 +123,9 @@ class PolicyContextBuilder:
             'clusterRoles': cluster_roles})
         if request.get('namespace'):
             ctx.json_context.add_namespace(request['namespace'])
+        # the `images.` context variable is available to every rule
+        # (reference: NewPolicyContextFromAdmissionRequest →
+        # AddImageInfos; mutate foreach preconditions rely on it)
+        from ..engine.image_verify import _add_resource_images
+        _add_resource_images(ctx)
         return ctx

@@ -238,13 +238,44 @@ class ResourceHandlers:
                  ur_sink: Optional[Callable] = None,
                  registry_client=None,
                  device: bool = True,
-                 openapi_manager=None):
+                 openapi_manager=None,
+                 client=None):
         if openapi_manager is None:
             from ..openapi.manager import Manager
             openapi_manager = Manager()
         self.openapi_manager = openapi_manager
         self.cache = cache
+        if engine is None and client is not None:
+            # wire the engine's context loaders (ConfigMap resolution +
+            # APICall urlPath entries) to the cluster client the daemon
+            # serves (reference: cmd/kyverno/main.go engine construction
+            # → pkg/engine/jsonContext.go:23 ContextLoaderFactory)
+            from ..engine.apicall import make_context_loader
+            engine = Engine(context_loader=make_context_loader(
+                dclient=client, registry_client=registry_client))
         self.engine = engine or Engine()
+        if pc_builder is None and client is not None:
+            # short-TTL cache: the reference serves exceptions from an
+            # informer cache — per-request LIST round trips would hammer
+            # the API server under admission load
+            _exc_cache = {'at': 0.0, 'items': []}
+
+            def _list_exceptions():
+                now = time.time()
+                if now - _exc_cache['at'] > 1.0:
+                    out = []
+                    for api_version in ('kyverno.io/v2alpha1',
+                                        'kyverno.io/v2beta1'):
+                        try:
+                            out += client.list_resource(
+                                api_version, 'PolicyException')
+                        except Exception:  # noqa: BLE001
+                            pass
+                    _exc_cache['items'] = out
+                    _exc_cache['at'] = now
+                return _exc_cache['items']
+            pc_builder = admission.PolicyContextBuilder(
+                configuration, exception_lister=_list_exceptions)
         self.pc_builder = pc_builder or admission.PolicyContextBuilder(
             configuration)
         self.configuration = configuration
@@ -501,6 +532,26 @@ class ResourceHandlers:
 
     # -- mutate -----------------------------------------------------------
 
+    @staticmethod
+    def _canonicalize_context_images(pctx) -> None:
+        from ..engine.mutate.jsonpatch import apply_patch
+        from ..utils.image_extract import extract_images_from_resource
+        try:
+            infos = extract_images_from_resource(pctx.new_resource, None)
+        except Exception:  # noqa: BLE001 - no images is the common case
+            return
+        ops = [{'op': 'replace', 'path': info.pointer, 'value': str(info)}
+               for group in infos.values() for info in group.values()
+               if info.pointer]
+        if not ops:
+            return
+        import copy as _copy
+        try:
+            patched = apply_patch(_copy.deepcopy(pctx.new_resource), ops)
+            pctx.json_context.add_resource(patched)
+        except Exception:  # noqa: BLE001 - context stays unpatched
+            pass
+
     def mutate(self, request: dict, failure_policy: str = 'Fail') -> dict:
         """reference: pkg/webhooks/resource/handlers.go:157 Mutate +
         mutation.go:80 applyMutations (sequential, cumulative)."""
@@ -516,6 +567,12 @@ class ResourceHandlers:
             return admission.response(uid, False,
                                       f'failed to build policy context: {e}')
         pctx.namespace_labels = self.namespace_labels(ns)
+        # canonicalize images in the JSON context's request.object so
+        # {{request.object...image}} variables resolve to the full
+        # registry form; the stored resource and emitted patches keep the
+        # original spelling (reference: handlers.go:174 →
+        # pkg/engine/context/imageutils.go:12 MutateResourceWithImageInfo)
+        self._canonicalize_context_images(pctx)
 
         patches: List[dict] = []
         responses: List[EngineResponse] = []
