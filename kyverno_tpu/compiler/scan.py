@@ -397,6 +397,17 @@ class BatchScanner:
                     self._evaluator.n_programs, self._evaluator.n_cols)
                 return s[:ln], d[:ln], fd[:ln]
             s, d, fd = out
+            if self.mesh is not None:
+                import jax
+                if jax.process_count() > 1:
+                    # multi-host mesh: each process only holds its local
+                    # shards of the batch axis — gather the full
+                    # matrices so every host assembles identical reports
+                    # (the reference replicates this work per replica)
+                    from jax.experimental import multihost_utils
+                    s = multihost_utils.process_allgather(s, tiled=True)
+                    d = multihost_utils.process_allgather(d, tiled=True)
+                    fd = multihost_utils.process_allgather(fd, tiled=True)
             return (np.asarray(s)[:ln], np.asarray(d)[:ln],
                     np.asarray(fd)[:ln])
 

@@ -41,7 +41,14 @@ def mutate_rule(rule_raw: dict, ctx: Context, resource: dict) -> MutateResponse:
     """Apply one mutate rule to a resource
     (reference: pkg/engine/mutate/mutation.go:38 Mutate)."""
     try:
-        updated_rule = vars_mod.substitute_all(ctx, copy.deepcopy(rule_raw))
+        if vars_mod.tree_has_variables(rule_raw):
+            updated_rule = vars_mod.substitute_all(
+                ctx, copy.deepcopy(rule_raw))
+        else:
+            # constant rule: substitution is the identity, and every
+            # downstream consumer copies before mutating — skip the
+            # per-resource deepcopy + walk (bulk-apply hot path)
+            updated_rule = rule_raw
     except (SubstitutionError, ContextError, InvalidVariableError) as e:
         return _error_response('variable substitution failed', e)
     mutation = updated_rule.get('mutate') or {}
@@ -104,11 +111,26 @@ def _apply_strategic_merge(overlay: Any, resource: dict) -> MutateResponse:
                           'applied strategic merge patch')
 
 
+_PATCH_TEXT_CACHE: dict = {}
+
+
+def _load_patches_cached(patch_text: str):
+    """The patch text is a rule constant; parsing it per resource
+    dominated bulk applies.  apply_patch treats ops read-only."""
+    ops = _PATCH_TEXT_CACHE.get(patch_text)
+    if ops is None:
+        if len(_PATCH_TEXT_CACHE) > 1024:
+            _PATCH_TEXT_CACHE.clear()
+        ops = load_patches(patch_text)
+        _PATCH_TEXT_CACHE[patch_text] = ops
+    return ops
+
+
 def _apply_json6902(patch_text: Any, resource: dict) -> MutateResponse:
     # reference: pkg/engine/mutate/patch/patchJSON6902.go
     try:
         if isinstance(patch_text, str):
-            ops = load_patches(patch_text)
+            ops = _load_patches_cached(patch_text)
         else:
             ops = patch_text
         patched = apply_patch(resource, ops)

@@ -301,17 +301,20 @@ def _delete_anchors_in_list(node: list, traverse_mapping: bool) -> Tuple[list, b
 # Stage 2: merge
 
 def strategic_merge(base: Any, patch: Any) -> Any:
+    """Pure merge: inputs are never mutated and the OUTPUT structurally
+    shares unmodified subtrees with them (new containers are built only
+    along patched paths — the same copy-on-write discipline as the JSON
+    context's merge_patch).  Deep-copying the whole base per map level
+    dominated bulk-apply profiles."""
     if isinstance(patch, dict):
         directive = patch.get('$patch')
         if directive == 'delete':
             return None
         if directive == 'replace':
-            out = {k: copy.deepcopy(v) for k, v in patch.items()
-                   if k != '$patch'}
-            return out
+            return {k: v for k, v in patch.items() if k != '$patch'}
         if not isinstance(base, dict):
             base = {}
-        out = {k: copy.deepcopy(v) for k, v in base.items()}
+        out = dict(base)
         for k, v in patch.items():
             if k == '$patch':
                 continue
@@ -333,9 +336,9 @@ def strategic_merge(base: Any, patch: Any) -> Any:
             key = _associative_key(base, patch)
             if key is not None:
                 return _merge_associative(base, patch, key)
-        return [x for x in (_strip_directives(e) for e in copy.deepcopy(patch))
+        return [x for x in (_strip_directives(e) for e in patch)
                 if x is not None]
-    return copy.deepcopy(patch)
+    return patch
 
 
 def _strip_directives(v: Any) -> Any:
@@ -362,12 +365,12 @@ def _associative_key(base: list, patch: list) -> Optional[str]:
 
 
 def _merge_associative(base: list, patch: list, key: str) -> list:
-    out = [copy.deepcopy(e) for e in base]
+    out = list(base)  # unmerged elements are shared, never mutated
     index = {e.get(key): i for i, e in enumerate(out)
              if isinstance(e, dict)}
     for p in patch:
         if not isinstance(p, dict):
-            out.append(copy.deepcopy(p))
+            out.append(p)
             continue
         k = p.get(key)
         if p.get('$patch') == 'delete':

@@ -76,6 +76,36 @@ def default_resolver(ctx: Context, variable: str) -> Any:
     return ctx.query(variable)
 
 
+def tree_has_variables(document: Any) -> bool:
+    """True when any string in the tree carries a ``{{..}}`` variable or
+    a ``$(..)`` reference — var-free rule trees skip the per-resource
+    deepcopy + substitution walk entirely (bulk-apply hot path).
+    Memoized by identity: rule dicts are immutable for a policy's
+    lifetime."""
+    doc_id = id(document)
+    hit = _VARFREE_CACHE.get(doc_id)
+    if hit is not None and hit[0] is document:
+        return hit[1]
+    result = _scan_vars(document)
+    if len(_VARFREE_CACHE) > 4096:
+        _VARFREE_CACHE.clear()
+    _VARFREE_CACHE[doc_id] = (document, result)
+    return result
+
+
+_VARFREE_CACHE: dict = {}
+
+
+def _scan_vars(doc: Any) -> bool:
+    if isinstance(doc, str):
+        return '{{' in doc or '$(' in doc
+    if isinstance(doc, dict):
+        return any(_scan_vars(k) or _scan_vars(v) for k, v in doc.items())
+    if isinstance(doc, list):
+        return any(_scan_vars(v) for v in doc)
+    return False
+
+
 def substitute_all(ctx: Context, document: Any) -> Any:
     """Substitute references then variables across a JSON document
     (reference: pkg/engine/variables/vars.go:82 SubstituteAll)."""

@@ -43,6 +43,30 @@ resources = [bench.make_pod(rng, i) for i in range(24)]
 mesh = make_mesh()   # global devices across both processes
 assert mesh.devices.size == jax.device_count() == 4  # 2 per process
 statuses, summary = distributed_scan_step(cps, mesh, resources)
+
+# streamed REPORT path across the same multi-host mesh: >= 3 chunks
+# (KTPU_SCAN_CHUNK=16 over 40 resources), reports must be identical on
+# every host and equal to the single-process run (timestamps pinned)
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.reports.results import set_responses
+from kyverno_tpu.reports.types import new_background_scan_report
+stream_resources = [bench.make_pod(rng, 1000 + i) for i in range(40)]
+scanner = BatchScanner(policies, mesh=mesh)
+report_dump = []
+for resource, responses in zip(stream_resources,
+                               scanner.scan_stream(stream_resources)):
+    report = new_background_scan_report(resource)
+    relevant = [r for r in responses if r.policy_response.rules]
+    set_responses(report, *relevant, now=0)
+    # result dicts are shared flyweights: sanitize into copies
+    report['results'] = [
+        {k: v for k, v in res.items() if k != 'timestamp'}
+        for res in report.get('results') or []]
+    report_dump.append(report)
+import hashlib
+report_hash = hashlib.sha256(
+    json.dumps(report_dump, sort_keys=True).encode()).hexdigest()
+
 print('RESULT ' + json.dumps({
     'process': jax.process_index(),
     'leader': mesh_is_leader(),
@@ -50,6 +74,8 @@ print('RESULT ' + json.dumps({
     'local_devices': jax.local_device_count(),
     'summary': np.asarray(summary).tolist(),
     'status_sum': int(np.asarray(statuses).sum()),
+    'n_stream_reports': len(report_dump),
+    'report_hash': report_hash,
 }))
 '''
 
@@ -66,6 +92,7 @@ def test_two_process_distributed_scan_agrees():
     env = dict(os.environ)
     env['JAX_PLATFORMS'] = 'cpu'
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+    env['KTPU_SCAN_CHUNK'] = '16'   # 40 resources -> 3 streamed chunks
     env.pop('JAX_NUM_PROCESSES', None)
     procs = [subprocess.Popen([sys.executable, '-c', code, str(i)],
                               env=env, stdout=subprocess.PIPE,
@@ -88,6 +115,10 @@ def test_two_process_distributed_scan_agrees():
     # and both processes reconstruct identical full status matrices
     assert by_proc[0]['summary'] == by_proc[1]['summary']
     assert by_proc[0]['status_sum'] == by_proc[1]['status_sum']
+    # the streamed report path ran >= 3 chunks and produced identical
+    # reports on both hosts
+    assert by_proc[0]['n_stream_reports'] == 40
+    assert by_proc[0]['report_hash'] == by_proc[1]['report_hash']
 
     # ground truth: the same batch on a single-process evaluator
     import random
@@ -109,3 +140,27 @@ def test_two_process_distributed_scan_agrees():
     evaluator = build_evaluator(cps)
     s, d, fd = evaluator(t, layout)
     assert int(np.asarray(s).sum()) == by_proc[0]['status_sum']
+
+    # single-process ground truth for the streamed report path
+    import hashlib
+    import json as _json
+
+    from kyverno_tpu.compiler.scan import BatchScanner
+    from kyverno_tpu.reports.results import set_responses
+    from kyverno_tpu.reports.types import new_background_scan_report
+
+    stream_resources = [bench.make_pod(rng, 1000 + i) for i in range(40)]
+    scanner = BatchScanner(policies)
+    dump = []
+    for resource, responses in zip(stream_resources,
+                                   scanner.scan_stream(stream_resources)):
+        report = new_background_scan_report(resource)
+        relevant = [r for r in responses if r.policy_response.rules]
+        set_responses(report, *relevant, now=0)
+        report['results'] = [
+            {k: v for k, v in res.items() if k != 'timestamp'}
+            for res in report.get('results') or []]
+        dump.append(report)
+    want = hashlib.sha256(
+        _json.dumps(dump, sort_keys=True).encode()).hexdigest()
+    assert want == by_proc[0]['report_hash']
