@@ -58,6 +58,40 @@ class GenerateController:
         # policy_getter(policy_key) -> Policy; defaults to the client store
         self.policy_getter = policy_getter or (
             lambda key: get_policy(client, key))
+        # permission pre-flight results, keyed (kind, namespace) with a
+        # short TTL: RBAC changes live (the shipped ClusterRoles are
+        # aggregated), so both a cached denial after the admin grants
+        # the permission and a cached allow after revocation must age out
+        self._auth_cache: Dict[Tuple[str, str],
+                               Tuple[float, Optional[str]]] = {}
+        self._auth_ttl = float(
+            __import__('os').environ.get('KTPU_AUTH_TTL', '60'))
+
+    def _check_generate_auth(self, kind: str, namespace: str
+                             ) -> Optional[str]:
+        """SSAR pre-flight before applying a generate target: create/
+        update/get/delete on the target kind (reference:
+        pkg/policy/generate/auth.go Operations + validate.go:130
+        canIGenerate — enforced here so a permission lost after policy
+        admission still fails the UR instead of erroring mid-apply)."""
+        import time as _time
+        from ..auth import Auth
+        from ..auth.auth import can_i_generate_error
+        if not kind:
+            return None
+        key = (kind, namespace)
+        hit = self._auth_cache.get(key)
+        now = _time.monotonic()
+        if hit is not None and now - hit[0] < self._auth_ttl:
+            return hit[1]
+        try:
+            err = can_i_generate_error(Auth(self.client), kind, namespace)
+        except AttributeError:
+            # client without an access-review surface (bare test doubles):
+            # behave like the reference with full RBAC
+            err = None
+        self._auth_cache[key] = (now, err)
+        return err
 
     # -- UR processing -------------------------------------------------------
 
@@ -169,6 +203,17 @@ class GenerateController:
                 raise ValueError('generate kind can not be empty')
             if not name:
                 raise ValueError('generate name can not be empty')
+            auth_err = self._check_generate_auth(kind, namespace)
+        else:
+            auth_err = None
+            for gvk in clone_list['kinds']:
+                # the full group/version/Kind string rides into the SSAR
+                # so group-qualified kinds probe the right GVR
+                auth_err = self._check_generate_auth(str(gvk), namespace)
+                if auth_err:
+                    break
+        if auth_err:
+            raise PermissionError(auth_err)
 
         if clone.get('name'):
             data, mode, err = self._manage_clone(

@@ -53,8 +53,10 @@ class AdmissionController:
         from ..controllers.policymetrics import PolicyMetricsController
         self.policy_metrics = PolicyMetricsController(
             setup.client, setup.metrics)
+        from ..webhooks.server import PolicyHandlers
         self.server = WebhookServer(
             self.handlers, configuration=setup.configuration,
+            policy_handlers=PolicyHandlers(setup.client),
             port=port, certfile=certfile, keyfile=keyfile)
         self.reconciler = WebhookConfigReconciler(
             setup.client, self.cert_renewer.ca_bundle(),

@@ -12,14 +12,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from ..controllers.cleanup import CleanupController
+from ..controllers.cleanup import (CleanupController,
+                                   validate_cleanup_admission)
 from ..controllers.leaderelection import mesh_is_leader
 from .internal import Setup, base_parser
 
 
 class CleanupHTTPServer:
     """Serves GET /cleanup?policy=<ns/name>
-    (reference: cmd/cleanup-controller/handlers/cleanup)."""
+    (reference: cmd/cleanup-controller/handlers/cleanup) and POST
+    /validate — CleanupPolicy admission with the delete/list permission
+    pre-flight (reference: cmd/cleanup-controller/handlers/admission/
+    policy.go + pkg/validation/cleanuppolicy/validate.go)."""
 
     def __init__(self, controller: CleanupController, port: int = 0,
                  host: str = '', certfile: Optional[str] = None,
@@ -45,6 +49,27 @@ class CleanupHTTPServer:
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: A003 - quiet
                 pass
+
+            def do_POST(self):  # noqa: N802
+                import json
+                if urlparse(self.path).path != '/validate':
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get('Content-Length') or 0)
+                review = json.loads(self.rfile.read(n) or b'{}')
+                request = review.get('request') or {}
+                resp = validate_cleanup_admission(request,
+                                                  controller.client)
+                body = json.dumps({
+                    'apiVersion': 'admission.k8s.io/v1',
+                    'kind': 'AdmissionReview',
+                    'response': resp}).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
                 parsed = urlparse(self.path)

@@ -51,6 +51,49 @@ def parse_cron(expr: str) -> Tuple[set, set, set, set, set]:
     return tuple(out)
 
 
+def validate_cleanup_policy_auth(doc: dict, client) -> Optional[str]:
+    """Permission pre-flight for a CleanupPolicy: the controller must be
+    able to 'delete' and 'list' every matched kind (reference:
+    pkg/validation/cleanuppolicy/validate.go:67 validateAuth).  Returns
+    an error string or None."""
+    from ..auth import CanI
+    namespace = ((doc.get('metadata') or {}).get('namespace') or '')
+    spec = doc.get('spec') or {}
+    match = spec.get('match') or {}
+    kinds = set()
+    for f in [match] + (match.get('any') or []) + (match.get('all') or []):
+        kinds.update((f.get('resources') or {}).get('kinds') or [])
+    for kind in sorted(kinds):
+        if not CanI(client, kind, namespace, 'delete').run_access_check():
+            return (f'cleanup controller has no permission to delete '
+                    f'kind {kind}')
+        if not CanI(client, kind, namespace, 'list').run_access_check():
+            return (f'cleanup controller has no permission to list '
+                    f'kind {kind}')
+    return None
+
+
+def validate_cleanup_admission(request: dict, client) -> dict:
+    """CleanupPolicy admission response: structural checks (schedule,
+    match) then the delete/list permission pre-flight (reference:
+    cmd/cleanup-controller/handlers/admission/policy.go Validate →
+    pkg/validation/cleanuppolicy/validate.go)."""
+    from ..webhooks import admission
+    uid = request.get('uid', '')
+    doc = admission.request_resource(request) or {}
+    spec = doc.get('spec') or {}
+    try:
+        parse_cron(str(spec.get('schedule', '')))
+    except ValueError as e:
+        return admission.response(uid, False, str(e))
+    if not spec.get('match'):
+        return admission.response(uid, False, 'spec.match is required')
+    err = validate_cleanup_policy_auth(doc, client)
+    if err is not None:
+        return admission.response(uid, False, err)
+    return admission.response(uid, True)
+
+
 def cron_matches(expr: str, ts: float) -> bool:
     minute, hour, dom, month, dow = parse_cron(expr)
     t = time.gmtime(ts)

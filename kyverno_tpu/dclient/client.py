@@ -56,6 +56,23 @@ class FakeClient:
         self._lock = threading.RLock()
         # subscribers get (event_type, resource) for informer-style wiring
         self._watchers: List[Callable[[str, dict], None]] = []
+        # SelfSubjectAccessReview policy: attrs -> (allowed, reason).
+        # Defaults to allow-all, matching a kyverno install with the
+        # shipped aggregated ClusterRoles in place.
+        self.access_review_hook: Optional[
+            Callable[[dict], Tuple[bool, str]]] = None
+
+    # -- access review -------------------------------------------------------
+
+    def create_access_review(self, attrs: dict) -> dict:
+        """Create a SelfSubjectAccessReview; returns its status dict
+        (reference: authorizationv1 SelfSubjectAccessReviews().Create,
+        used by pkg/auth/auth.go:90)."""
+        hook = self.access_review_hook
+        if hook is None:
+            return {'allowed': True}
+        allowed, reason = hook(attrs)
+        return {'allowed': bool(allowed), 'reason': reason}
 
     # -- watch ---------------------------------------------------------------
 

@@ -179,6 +179,17 @@ class FakeApiServer:
 
             def do_POST(self):  # noqa: N802
                 path, q = self._route()
+                if path == ('/apis/authorization.k8s.io/v1/'
+                            'selfsubjectaccessreviews'):
+                    # SSARs are ephemeral: evaluated, never stored
+                    # (reference: authorization/v1 SelfSubjectAccessReview)
+                    ssar = json.loads(self._read_body())
+                    attrs = ((ssar.get('spec') or {})
+                             .get('resourceAttributes') or {})
+                    status = outer.store.create_access_review(attrs)
+                    ssar['status'] = status
+                    self._send(201, json.dumps(ssar).encode())
+                    return
                 try:
                     parsed = outer._parse(path)
                     if parsed is None:

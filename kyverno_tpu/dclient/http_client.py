@@ -302,6 +302,19 @@ class HTTPClient:
         return self.update_resource(api_version, kind, namespace, resource,
                                     dry_run, subresource='status')
 
+    def create_access_review(self, attrs: dict) -> dict:
+        """POST a SelfSubjectAccessReview; returns its status dict
+        (reference: pkg/auth/auth.go:90 ssarClient.Create)."""
+        ssar = {
+            'apiVersion': 'authorization.k8s.io/v1',
+            'kind': 'SelfSubjectAccessReview',
+            'spec': {'resourceAttributes': attrs},
+        }
+        data = self._request(
+            'POST', '/apis/authorization.k8s.io/v1/selfsubjectaccessreviews',
+            json.dumps(ssar).encode())
+        return (json.loads(data).get('status') or {})
+
     def patch_resource(self, api_version: str, kind: str, namespace: str,
                        name: str, patch: List[dict]) -> dict:
         """reference: dclient.PatchResource (RFC 6902 JSON patch)."""

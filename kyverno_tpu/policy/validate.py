@@ -39,9 +39,13 @@ class PolicyValidationError(Exception):
     pass
 
 
-def validate_policy(doc: dict) -> List[str]:
+def validate_policy(doc: dict, client=None) -> List[str]:
     """Validate a Policy/ClusterPolicy document; returns warnings, raises
-    PolicyValidationError on rejection."""
+    PolicyValidationError on rejection.
+
+    ``client`` enables the generate permission pre-flight (SSAR probes,
+    reference: pkg/policy/actions.go:50); without one the mock allow-all
+    auth is used, matching the reference's offline mode."""
     warnings: List[str] = []
     if not isinstance(doc, dict):
         raise PolicyValidationError('policy must be an object')
@@ -92,6 +96,11 @@ def validate_policy(doc: dict) -> List[str]:
             _validate_validate_rule(rule['validate'], f'{path}.validate')
         if rule.get('mutate') is not None:
             _validate_mutate_rule(rule['mutate'], f'{path}.mutate')
+        if rule.get('generate') is not None:
+            from .generate_validate import validate_generate_rule
+            err = validate_generate_rule(rule, i, client)
+            if err is not None:
+                raise PolicyValidationError(err)
         _validate_conditions_shape(rule.get('preconditions'),
                                    f'{path}.preconditions')
         if background:
@@ -230,12 +239,12 @@ def _check_wildcard_kinds(rule: dict, path: str) -> None:
 # ---------------------------------------------------------------------------
 # admission endpoints (reference: pkg/webhooks/policy/handlers.go:43)
 
-def validate_policy_admission(request: dict) -> dict:
+def validate_policy_admission(request: dict, client=None) -> dict:
     from ..webhooks import admission
     uid = request.get('uid', '')
     doc = admission.request_resource(request)
     try:
-        warnings = validate_policy(doc)
+        warnings = validate_policy(doc, client)
     except PolicyValidationError as e:
         return admission.response(uid, False, str(e))
     return admission.response(uid, True, '', warnings)

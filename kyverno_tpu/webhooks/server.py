@@ -35,11 +35,17 @@ def _allow_all(request: dict) -> dict:
 
 class PolicyHandlers:
     """Policy CR admission (validate/mutate) — overridden by the policy
-    lifecycle module (reference: pkg/webhooks/policy/handlers.go)."""
+    lifecycle module (reference: pkg/webhooks/policy/handlers.go).
+
+    ``client`` enables SSAR-backed generate permission pre-flight
+    (reference: pkg/policy/actions.go validateActions, mock=false)."""
+
+    def __init__(self, client=None):
+        self.client = client
 
     def validate(self, request: dict) -> dict:
         from ..policy.validate import validate_policy_admission
-        return validate_policy_admission(request)
+        return validate_policy_admission(request, self.client)
 
     def mutate(self, request: dict) -> dict:
         return _allow_all(request)

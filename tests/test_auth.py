@@ -1,0 +1,333 @@
+"""Generate/cleanup permission pre-flight (SSAR) tests.
+
+Mirrors the reference's auth suite: pkg/auth/auth.go CanIOptions,
+pkg/policy/generate/{auth.go,validate.go,validate_test.go}, and
+pkg/validation/cleanuppolicy/validate.go validateAuth.
+"""
+
+import pytest
+
+from kyverno_tpu.auth import Auth, CanI, FakeAuth, gvr_from_kind
+from kyverno_tpu.background.generate import GenerateController
+from kyverno_tpu.background.updaterequest import (
+    STATE_FAILED, UpdateRequest, UpdateRequestGenerator,
+)
+from kyverno_tpu.controllers.cleanup import validate_cleanup_policy_auth
+from kyverno_tpu.dclient.client import FakeClient
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.policy.generate_validate import (
+    GenerateValidator, validate_generate_rule,
+)
+from kyverno_tpu.policy.validate import PolicyValidationError, validate_policy
+
+
+def deny(*denied_verbs, kinds=None):
+    """Access-review hook denying specific verbs (optionally per plural)."""
+    def hook(attrs):
+        if attrs['verb'] in denied_verbs and \
+                (kinds is None or attrs['resource'] in kinds):
+            return False, f"cannot {attrs['verb']} {attrs['resource']}"
+        return True, ''
+    return hook
+
+
+class TestGVR:
+    def test_bare_kind(self):
+        assert gvr_from_kind('NetworkPolicy') == ('', 'networkpolicies')
+        assert gvr_from_kind('ConfigMap') == ('', 'configmaps')
+        assert gvr_from_kind('Ingress') == ('', 'ingresses')
+
+    def test_group_version_kind(self):
+        assert gvr_from_kind('apps/v1/Deployment') == ('apps', 'deployments')
+        assert gvr_from_kind('v1/Secret') == ('', 'secrets')
+        assert gvr_from_kind('networking.k8s.io/v1/NetworkPolicy') == \
+            ('networking.k8s.io', 'networkpolicies')
+
+
+class TestCanI:
+    def test_default_allow_all(self):
+        client = FakeClient()
+        assert CanI(client, 'ConfigMap', 'ns', 'create').run_access_check()
+
+    def test_denied_verb(self):
+        client = FakeClient()
+        client.access_review_hook = deny('delete')
+        assert CanI(client, 'ConfigMap', 'ns', 'create').run_access_check()
+        assert not CanI(client, 'ConfigMap', 'ns',
+                        'delete').run_access_check()
+
+    def test_empty_kind_raises(self):
+        with pytest.raises(ValueError):
+            CanI(FakeClient(), '', 'ns', 'create').run_access_check()
+
+    def test_auth_verbs(self):
+        client = FakeClient()
+        client.access_review_hook = deny('update', kinds={'secrets'})
+        auth = Auth(client)
+        assert auth.can_i_create('Secret', 'ns')
+        assert not auth.can_i_update('Secret', 'ns')
+        assert auth.can_i_update('ConfigMap', 'ns')
+
+
+GEN_DATA_RULE = {
+    'kind': 'NetworkPolicy',
+    'name': 'defaultnetworkpolicy',
+    'data': {'spec': {'podSelector': {},
+                      'policyTypes': ['Ingress', 'Egress']}},
+}
+
+
+class TestGenerateValidator:
+    """reference: pkg/policy/generate/validate_test.go"""
+
+    def test_valid_data_rule_fake_auth(self):
+        _, err = GenerateValidator(GEN_DATA_RULE, FakeAuth()).validate()
+        assert err is None
+
+    def test_data_and_clone_exclusive(self):
+        rule = dict(GEN_DATA_RULE, clone={'name': 'x', 'namespace': 'y'})
+        _, err = GenerateValidator(rule, FakeAuth()).validate()
+        assert 'only one of data or clone' in err
+
+    def test_name_required(self):
+        rule = {'kind': 'ConfigMap', 'data': {}}
+        path, err = GenerateValidator(rule, FakeAuth()).validate()
+        assert path == 'name' and 'empty' in err
+
+    def test_clonelist_excludes_name_kind(self):
+        rule = {'cloneList': {'kinds': ['v1/Secret']}, 'name': 'x'}
+        path, err = GenerateValidator(rule, FakeAuth()).validate()
+        assert path == 'name' and 'cloneList' in err
+
+    def test_denied_create_rejected(self):
+        client = FakeClient()
+        client.access_review_hook = deny('create')
+        _, err = GenerateValidator(GEN_DATA_RULE, Auth(client)).validate()
+        assert "permissions to 'create'" in err
+        assert 'kyverno:generate' in err
+
+    def test_denied_delete_rejected(self):
+        client = FakeClient()
+        client.access_review_hook = deny('delete')
+        _, err = GenerateValidator(GEN_DATA_RULE, Auth(client)).validate()
+        assert "permissions to 'delete'" in err
+
+    def test_variable_kind_skips_auth(self):
+        client = FakeClient()
+        client.access_review_hook = deny('create', 'get', 'update', 'delete')
+        rule = {'kind': 'ConfigMap', 'name': 'x',
+                'namespace': '{{request.object.metadata.name}}',
+                'data': {}}
+        _, err = GenerateValidator(rule, Auth(client)).validate()
+        assert err is None
+
+    def test_clone_source_needs_get(self):
+        client = FakeClient()
+        client.access_review_hook = deny('get')
+        rule = {'kind': 'Secret', 'name': 'tgt', 'namespace': 'ns',
+                'clone': {'name': 'src', 'namespace': 'default'}}
+        path, err = GenerateValidator(rule, Auth(client)).validate()
+        assert "permissions to 'get'" in err
+
+    def test_clonelist_checks_each_kind(self):
+        client = FakeClient()
+        client.access_review_hook = deny('update', kinds={'secrets'})
+        rule = {'namespace': 'ns',
+                'cloneList': {'namespace': 'default',
+                              'kinds': ['v1/ConfigMap', 'v1/Secret']}}
+        _, err = GenerateValidator(rule, Auth(client)).validate()
+        assert "'update' resource Secret" in err
+
+
+class TestPolicyValidationIntegration:
+    POLICY = {
+        'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+        'metadata': {'name': 'gen-netpol'},
+        'spec': {'rules': [{
+            'name': 'default-deny',
+            'match': {'any': [{'resources': {'kinds': ['Namespace']}}]},
+            'generate': {
+                'apiVersion': 'networking.k8s.io/v1',
+                'kind': 'NetworkPolicy', 'name': 'default-deny',
+                'namespace': 'team-a',
+                'data': {'spec': {'podSelector': {}}},
+            }}]},
+    }
+
+    def test_policy_passes_with_permissions(self):
+        assert validate_policy(self.POLICY, FakeClient()) == []
+
+    def test_policy_rejected_without_permissions(self):
+        client = FakeClient()
+        client.access_review_hook = deny('create',
+                                         kinds={'networkpolicies'})
+        with pytest.raises(PolicyValidationError) as e:
+            validate_policy(self.POLICY, client)
+        assert "permissions to 'create'" in str(e.value)
+
+    def test_variable_namespace_skips_auth(self):
+        # reference: validate.go:174 — unresolved variables skip probes
+        policy = {
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 'gen-netpol'},
+            'spec': {'rules': [{
+                'name': 'default-deny',
+                'match': {'any': [{'resources': {'kinds': ['Namespace']}}]},
+                'generate': {
+                    'apiVersion': 'networking.k8s.io/v1',
+                    'kind': 'NetworkPolicy', 'name': 'default-deny',
+                    'namespace': '{{request.object.metadata.name}}',
+                    'data': {'spec': {'podSelector': {}}},
+                }}]},
+        }
+        client = FakeClient()
+        client.access_review_hook = deny('create')
+        assert validate_policy(policy, client) == []
+
+    def test_offline_mode_allows(self):
+        # no client → mock auth (reference: actions.go mock=true)
+        assert validate_policy(self.POLICY) == []
+
+    def test_generate_kind_matches_trigger_kind_rejected(self):
+        # reference: actions.go:65
+        rule = {
+            'name': 'r', 'generate': {'kind': 'ConfigMap', 'name': 'x',
+                                      'data': {}},
+            'match': {'any': [{'resources': {'kinds': ['ConfigMap']}}]},
+        }
+        err = validate_generate_rule(rule, 0, None)
+        assert 'should not be the same' in err
+
+
+class TestURPreflight:
+    """The background processor re-checks permissions before applying
+    (a permission revoked after policy admission fails the UR)."""
+
+    def _ur(self, client):
+        trigger = {'apiVersion': 'v1', 'kind': 'Namespace',
+                   'metadata': {'name': 'team-a'}}
+        client.create_resource('v1', 'Namespace', '', trigger)
+        policy = {
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 'gen-netpol'},
+            'spec': {'rules': [{
+                'name': 'default-deny',
+                'match': {'any': [{'resources': {'kinds': ['Namespace']}}]},
+                'generate': {
+                    'apiVersion': 'networking.k8s.io/v1',
+                    'kind': 'NetworkPolicy', 'name': 'default-deny',
+                    'namespace': 'team-a',
+                    'data': {'spec': {'podSelector': {}}},
+                }}]},
+        }
+        client.create_resource('kyverno.io/v1', 'ClusterPolicy', '', policy)
+        gen = UpdateRequestGenerator(client)
+        gen.apply({
+            'type': 'generate', 'policy': 'gen-netpol',
+            'resource': {'apiVersion': 'v1', 'kind': 'Namespace',
+                         'name': 'team-a', 'namespace': ''},
+            'requestType': 'generate',
+        })
+        urs = client.list_resource('kyverno.io/v1beta1', 'UpdateRequest')
+        assert urs
+        return UpdateRequest(urs[0])
+
+    def test_apply_denied_fails_ur(self):
+        client = FakeClient()
+        client.access_review_hook = deny('create',
+                                         kinds={'networkpolicies'})
+        ur = self._ur(client)
+        ctrl = GenerateController(client, Engine())
+        err = ctrl.process_ur(ur)
+        assert err is not None
+        assert "permissions to 'create'" in str(err)
+        assert ur.state == STATE_FAILED
+        assert not client.list_resource('networking.k8s.io/v1',
+                                        'NetworkPolicy')
+
+    def test_apply_allowed_generates(self):
+        client = FakeClient()
+        ur = self._ur(client)
+        ctrl = GenerateController(client, Engine())
+        assert ctrl.process_ur(ur) is None
+        netpols = client.list_resource('networking.k8s.io/v1',
+                                       'NetworkPolicy')
+        assert len(netpols) == 1
+
+
+class TestAuthCacheTTL:
+    def test_denial_expires_after_grant(self, monkeypatch):
+        monkeypatch.setenv('KTPU_AUTH_TTL', '0')
+        client = FakeClient()
+        client.access_review_hook = deny('create')
+        ctrl = GenerateController(client, Engine())
+        assert "'create'" in ctrl._check_generate_auth('ConfigMap', 'ns')
+        # admin grants the permission; TTL=0 → next check re-probes
+        client.access_review_hook = None
+        assert ctrl._check_generate_auth('ConfigMap', 'ns') is None
+
+    def test_group_qualified_clonelist_probe(self):
+        seen = []
+        client = FakeClient()
+
+        def hook(attrs):
+            seen.append((attrs['group'], attrs['resource']))
+            return True, ''
+        client.access_review_hook = hook
+        ctrl = GenerateController(client, Engine())
+        assert ctrl._check_generate_auth(
+            'networking.k8s.io/v1/NetworkPolicy', 'ns') is None
+        assert ('networking.k8s.io', 'networkpolicies') in seen
+
+
+class TestCleanupAuth:
+    DOC = {
+        'apiVersion': 'kyverno.io/v2alpha1', 'kind': 'ClusterCleanupPolicy',
+        'metadata': {'name': 'sweep'},
+        'spec': {'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+                 'schedule': '*/5 * * * *'},
+    }
+
+    def test_allowed(self):
+        assert validate_cleanup_policy_auth(self.DOC, FakeClient()) is None
+
+    def test_denied_delete(self):
+        client = FakeClient()
+        client.access_review_hook = deny('delete')
+        err = validate_cleanup_policy_auth(self.DOC, client)
+        assert 'no permission to delete kind Pod' in err
+
+    def test_denied_list(self):
+        client = FakeClient()
+        client.access_review_hook = deny('list')
+        err = validate_cleanup_policy_auth(self.DOC, client)
+        assert 'no permission to list kind Pod' in err
+
+    def test_cleanup_validate_route(self):
+        """POST /validate on the cleanup daemon rejects a CleanupPolicy
+        the controller lacks delete permission for."""
+        import json
+        import urllib.request
+        from kyverno_tpu.cmd.cleanup_controller import CleanupHTTPServer
+        from kyverno_tpu.controllers.cleanup import CleanupController
+        client = FakeClient()
+        client.access_review_hook = deny('delete')
+        server = CleanupHTTPServer(CleanupController(client), host='127.0.0.1')
+        port = server.start()
+        try:
+            review = {'request': {'uid': 'u1', 'object': self.DOC}}
+            resp = json.load(urllib.request.urlopen(urllib.request.Request(
+                f'http://127.0.0.1:{port}/validate',
+                json.dumps(review).encode(),
+                {'Content-Type': 'application/json'})))
+            r = resp['response']
+            assert r['allowed'] is False
+            assert 'no permission to delete' in r['status']['message']
+            client.access_review_hook = None
+            resp = json.load(urllib.request.urlopen(urllib.request.Request(
+                f'http://127.0.0.1:{port}/validate',
+                json.dumps(review).encode(),
+                {'Content-Type': 'application/json'})))
+            assert resp['response']['allowed'] is True
+        finally:
+            server.stop()

@@ -149,6 +149,31 @@ class TestContract:
         assert got['spec'] == {'podSelector': {}}
 
 
+class TestAccessReview:
+    def test_access_review_default_allow(self, client):
+        status = client.create_access_review(
+            {'verb': 'create', 'group': '', 'resource': 'pods',
+             'namespace': 'default', 'subresource': ''})
+        assert status.get('allowed') is True
+
+    def test_access_review_denied_over_http(self):
+        with FakeApiServer() as srv:
+            srv.store.access_review_hook = \
+                lambda attrs: (attrs['verb'] != 'delete', 'rbac says no')
+            c = HTTPClient(ClusterConfig(server=srv.url))
+            try:
+                ok = c.create_access_review(
+                    {'verb': 'delete', 'group': '', 'resource': 'pods',
+                     'namespace': '', 'subresource': ''})
+                assert ok.get('allowed') is False
+                assert ok.get('reason') == 'rbac says no'
+                assert c.create_access_review(
+                    {'verb': 'get', 'group': '', 'resource': 'pods',
+                     'namespace': '', 'subresource': ''}).get('allowed')
+            finally:
+                c.close()
+
+
 class TestHttpOnly:
     """Transport behaviors with no in-memory analogue."""
 
