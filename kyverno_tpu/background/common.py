@@ -18,13 +18,20 @@ def get_policy(client, policy_key: str) -> Policy:
     """Resolve a UR's policy key (``ns/name`` for namespaced Policy, bare
     name for ClusterPolicy) from the store (reference:
     pkg/background/generate/generate.go:267 getPolicySpec)."""
+    from ..dclient.client import NotFoundError
     if '/' in policy_key:
         ns, name = policy_key.split('/', 1)
-        raw = client.get_resource('kyverno.io/v1', 'Policy', ns, name)
+        kind = 'Policy'
     else:
-        raw = client.get_resource('kyverno.io/v1', 'ClusterPolicy', '',
-                                  policy_key)
-    return Policy(raw)
+        ns, name, kind = '', policy_key, 'ClusterPolicy'
+    # policy CRDs serve multiple versions; the store holds whichever the
+    # manifest used
+    for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1', ''):
+        try:
+            return Policy(client.get_resource(api_version, kind, ns, name))
+        except NotFoundError:
+            continue
+    raise NotFoundError(f'{kind} "{policy_key}" not found')
 
 
 def get_trigger_resource(client, ur: UpdateRequest) -> Optional[dict]:

@@ -33,6 +33,22 @@ SKIP = 'SKIP'
 CREATE = 'CREATE'
 UPDATE = 'UPDATE'
 
+#: default apiVersions for generate rules that name only a kind — the
+#: reference resolves these through discovery (dclient.GetResource with
+#: empty apiVersion); the fake path needs the common built-ins
+_DEFAULT_API_VERSIONS = {
+    'ConfigMap': 'v1', 'Secret': 'v1', 'Namespace': 'v1',
+    'ServiceAccount': 'v1', 'Service': 'v1', 'LimitRange': 'v1',
+    'ResourceQuota': 'v1', 'Pod': 'v1',
+    'Role': 'rbac.authorization.k8s.io/v1',
+    'RoleBinding': 'rbac.authorization.k8s.io/v1',
+    'ClusterRole': 'rbac.authorization.k8s.io/v1',
+    'ClusterRoleBinding': 'rbac.authorization.k8s.io/v1',
+    'NetworkPolicy': 'networking.k8s.io/v1',
+    'Deployment': 'apps/v1',
+    'PodDisruptionBudget': 'policy/v1',
+}
+
 
 class GenerateResponseItem:
     __slots__ = ('data', 'action', 'name', 'kind', 'namespace',
@@ -197,7 +213,8 @@ class GenerateController:
         kind = gen.get('kind', '')
         name = gen.get('name', '')
         namespace = gen.get('namespace', '')
-        api_version = gen.get('apiVersion', '')
+        api_version = gen.get('apiVersion', '') or \
+            _DEFAULT_API_VERSIONS.get(kind, '')
         if not clone_list.get('kinds'):
             if not kind:
                 raise ValueError('generate kind can not be empty')
@@ -256,6 +273,12 @@ class GenerateController:
                 labels[BACKGROUND_GEN_RULE_LABEL] = rule.name
             labels[POLICY_NAME_LABEL] = policy.name
             labels[GR_NAME_LABEL] = ur.name
+            if clone.get('name') or clone_list.get('kinds'):
+                # cloned targets carry the cloning policy's name
+                # (reference: pkg/background/common/labels.go
+                # GenerateLabelsSet clone path)
+                labels['generate.kyverno.io/clone-policy-name'] = \
+                    policy.name
             synchronize = bool(rule.generation.get('synchronize'))
             if item.action == CREATE:
                 labels[SYNCHRONIZE_LABEL] = 'enable' if synchronize else 'disable'

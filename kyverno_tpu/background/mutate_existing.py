@@ -13,6 +13,7 @@ from ..api.policy import Policy, Rule
 from ..engine.api import PolicyContext, RuleStatus
 from ..engine.background import is_mutate_existing
 from ..engine.context import Context
+from ..dclient.client import NotFoundError
 from ..engine.variables import substitute_all
 from .common import get_policy, get_trigger_resource, new_background_context
 from .updaterequest import STATE_COMPLETED, STATE_FAILED, UpdateRequest
@@ -37,6 +38,10 @@ class MutateExistingController:
         except Exception as exc:  # noqa: BLE001
             ur.set_status(STATE_FAILED, str(exc))
             return exc
+        if policy is None:
+            err = NotFoundError(f'policy {ur.policy_key!r} not found')
+            ur.set_status(STATE_FAILED, str(err))
+            return err
         rules = [r for r in (policy.spec.get('rules') or [])
                  if is_mutate_existing(Rule(r))]
         pctx = None
@@ -46,6 +51,13 @@ class MutateExistingController:
             except Exception as exc:  # noqa: BLE001
                 ur.set_status(STATE_FAILED, str(exc))
                 return exc
+            if trigger is None:
+                # DELETE triggers resolve from the admission request's
+                # old object (reference: pkg/background/common/
+                # context.go:50 — trigger = &old when nil)
+                old = (ur.admission_request or {}).get('oldObject')
+                if isinstance(old, dict) and old:
+                    trigger = old
             if trigger is not None:
                 pctx = new_background_context(self.client, ur, policy, trigger)
         if pctx is not None:

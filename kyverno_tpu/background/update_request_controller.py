@@ -46,7 +46,29 @@ class UpdateRequestController:
         for ur in self.list_urs(STATE_PENDING):
             self.sync_update_request(ur)
             n += 1
+        # synchronize=true generate URs re-reconcile continuously: the
+        # reference watches downstream/source changes and re-enqueues
+        # the UR (pkg/background/update_request_controller.go informer
+        # hooks); the tick model re-processes them each pass, which
+        # no-ops when everything already converged
+        for ur in self.list_urs(STATE_COMPLETED):
+            if ur.type != UR_GENERATE or not self._wants_sync(ur):
+                continue
+            # converged sync URs re-reconcile as no-ops; not counted as
+            # processed work
+            self.sync_update_request(ur)
         return n
+
+    def _wants_sync(self, ur: UpdateRequest) -> bool:
+        policy = None
+        try:
+            policy = self.generate.policy_getter(ur.policy_key)
+        except Exception:  # noqa: BLE001 - deleted policy: nothing to sync
+            return False
+        if policy is None:
+            return False
+        return any(bool((r.raw.get('generate') or {}).get('synchronize'))
+                   for r in policy.rules)
 
     def sync_update_request(self, ur: UpdateRequest) -> None:
         """reference: update_request_controller.go syncUpdateRequest"""

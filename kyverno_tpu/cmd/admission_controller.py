@@ -38,9 +38,15 @@ class AdmissionController:
             self._key_tmp.flush()
             certfile, keyfile = self._cert_tmp.name, self._key_tmp.name
         self._audit_threads: List[threading.Thread] = []
+        # admission events ride the bounded event controller (reference:
+        # pkg/event/controller.go wired in cmd/kyverno/main.go)
+        from ..observability.events import EventGenerator
+        self.event_generator = EventGenerator(setup.client)
+        self.event_generator.run()
         self.handlers = ResourceHandlers(
             self.cache, configuration=setup.configuration,
             ur_sink=self._create_ur, audit_sink=self._audit,
+            event_sink=self._events,
             client=setup.client)
         # CRD schema ingestion feeding the mutation schema checks
         # (reference: pkg/controllers/openapi/controller.go:148)
@@ -71,6 +77,11 @@ class AdmissionController:
         UpdateRequestGenerator(self.setup.client).apply(
             dict(ur_spec, requestType=ur_spec.get('type', 'generate')))
 
+    def _events(self, responses, blocked: bool) -> None:
+        from ..observability.events import events_for_responses
+        self.event_generator.add(
+            *events_for_responses(responses, blocked))
+
     def _audit(self, request: dict, _enforce_responses) -> None:
         """Audit-report hand-off: runs on a worker thread like the
         reference's goroutine (validation.go:182 handleAudit) so the
@@ -78,7 +89,8 @@ class AdmissionController:
         report CR write."""
         if request.get('operation') == 'DELETE':
             return
-        t = threading.Thread(target=self._audit_sync, args=(request,),
+        t = threading.Thread(target=self._audit_sync,
+                             args=(request, list(_enforce_responses or [])),
                              daemon=True, name='audit-report')
         t.start()
         self._audit_threads.append(t)
@@ -89,12 +101,14 @@ class AdmissionController:
         for t in list(self._audit_threads):
             t.join(timeout=30)
 
-    def _audit_sync(self, request: dict) -> None:
+    def _audit_sync(self, request: dict,
+                    enforce_responses=()) -> None:
         """reference: validation.go:156 buildAuditResponses — the AUDIT
-        policy set produces per-request AdmissionReport CRs for the
-        reports controller to aggregate."""
+        policy set plus the already-computed enforce responses produce
+        per-request AdmissionReport CRs for the reports controller to
+        aggregate (the reference reports over ALL engine responses)."""
         resource = request.get('object') or {}
-        responses = self.handlers.audit_responses(request)
+        responses = list(enforce_responses) +             self.handlers.audit_responses(request)
         relevant = [r for r in responses if r.policy_response.rules]
         if not relevant:
             return
@@ -135,6 +149,11 @@ class AdmissionController:
             self.reconciler.reconcile(policies)
             self.reconciler.heartbeat()
 
+    def close(self) -> None:
+        """Stop owned worker threads (event generator, audits)."""
+        self.flush_audits()
+        self.event_generator.stop()
+
     def run(self) -> None:
         if self.elector is not None:
             self.elector.run()
@@ -142,6 +161,7 @@ class AdmissionController:
         self.setup.install_signal_handlers()
         self.setup.run_until_stopped(self.tick, interval=5.0)
         self.server.stop()
+        self.close()
         if self.elector is not None:
             self.elector.release()
 

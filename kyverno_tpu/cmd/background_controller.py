@@ -27,27 +27,22 @@ class BackgroundController:
         self._seen_policies: dict = {}
 
     def _get_policy(self, key: str):
-        from ..api.policy import Policy
-        name = key.split('/')[-1]
-        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
-            for kind in ('ClusterPolicy', 'Policy'):
-                try:
-                    doc = self.setup.client.get_resource(
-                        api_version, kind, '', name)
-                    return Policy(doc)
-                except Exception:  # noqa: BLE001
-                    continue
-        return None
+        from ..background.common import get_policy
+        try:
+            return get_policy(self.setup.client, key)
+        except Exception:  # noqa: BLE001 - deleted policy
+            return None
 
     def tick(self) -> None:
         if not mesh_is_leader():
             return
         # policy lifecycle events from the stored CRs
         current = {}
-        for kind in ('ClusterPolicy', 'Policy'):
+        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
+          for kind in ('ClusterPolicy', 'Policy'):
             try:
                 for doc in self.setup.client.list_resource(
-                        'kyverno.io/v1', kind, '', None):
+                        api_version, kind, '', None):
                     meta = doc.get('metadata') or {}
                     key = f"{meta.get('namespace', '')}/{meta.get('name')}"
                     current[key] = doc

@@ -30,12 +30,15 @@ class ReportsController:
 
     def _policies(self) -> List[Policy]:
         docs = []
-        for kind in ('ClusterPolicy', 'Policy'):
-            try:
-                docs += self.setup.client.list_resource(
-                    'kyverno.io/v1', kind, '', None)
-            except Exception:  # noqa: BLE001
-                continue
+        # policy CRDs are multi-version served (v1 storage, v2beta1
+        # conversion-identical for the fields the engine reads)
+        for api_version in ('kyverno.io/v1', 'kyverno.io/v2beta1'):
+            for kind in ('ClusterPolicy', 'Policy'):
+                try:
+                    docs += self.setup.client.list_resource(
+                        api_version, kind, '', None)
+                except Exception:  # noqa: BLE001
+                    continue
         return [Policy(d) for d in docs]
 
     def tick(self) -> None:

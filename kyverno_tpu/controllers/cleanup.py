@@ -86,8 +86,18 @@ def validate_cleanup_admission(request: dict, client) -> dict:
         parse_cron(str(spec.get('schedule', '')))
     except ValueError as e:
         return admission.response(uid, False, str(e))
-    if not spec.get('match'):
+    match = spec.get('match')
+    if not match:
         return admission.response(uid, False, 'spec.match is required')
+    # user infos are not allowed in cleanup match statements (reference:
+    # api/kyverno/v2alpha1 cleanup_policy_types ValidateMatchResources →
+    # match.GetUserInfo() must be empty)
+    for f in [match] + (match.get('any') or []) + (match.get('all') or []):
+        if f.get('subjects') or f.get('roles') or f.get('clusterRoles'):
+            return admission.response(
+                uid, False,
+                'cleanup policies do not support user infos in match: '
+                'not allowed here')
     err = validate_cleanup_policy_auth(doc, client)
     if err is not None:
         return admission.response(uid, False, err)
@@ -305,6 +315,10 @@ class CleanupController:
     def _conditions_met(self, conditions: Any, resource: dict) -> bool:
         ctx = Context()
         ctx.add_resource(resource)
+        # cleanup conditions address the candidate as {{ target.* }}
+        # (reference: cmd/cleanup-controller/handlers/cleanup/handlers.go
+        # enginectx.AddTargetResource)
+        ctx.add_target_resource(resource)
         try:
             substituted = substitute_all(ctx, conditions)
         except Exception:  # noqa: BLE001

@@ -21,6 +21,13 @@ DEFAULT_WEBHOOK_TIMEOUT = 10  # reference: webhook/controller.go:49
 
 VALIDATING_NAME = 'kyverno-resource-validating-webhook-cfg'
 MUTATING_NAME = 'kyverno-resource-mutating-webhook-cfg'
+# static control-plane webhooks (reference: pkg/config/config.go:22-34)
+POLICY_VALIDATING_NAME = 'kyverno-policy-validating-webhook-cfg'
+POLICY_MUTATING_NAME = 'kyverno-policy-mutating-webhook-cfg'
+VERIFY_MUTATING_NAME = 'kyverno-verify-mutating-webhook-cfg'
+#: stamped on every managed webhook configuration
+#: (reference: pkg/utils/kube ManagedByLabel via webhook/utils.go:101)
+MANAGED_BY_LABELS = {'webhook.kyverno.io/managed-by': 'kyverno'}
 LEASE_NAME = 'kyverno-health'
 # watchdog heartbeat: every 10s, stale after 100s
 # (reference: webhook/controller.go:215-275, IdleDeadline)
@@ -118,7 +125,82 @@ class WebhookConfigReconciler:
                     self._build_validating(policies))
         self._apply(MUTATING_NAME, 'MutatingWebhookConfiguration',
                     self._build_mutating(policies))
+        self._apply(POLICY_VALIDATING_NAME,
+                    'ValidatingWebhookConfiguration',
+                    self._build_policy_validating())
+        self._apply(POLICY_MUTATING_NAME, 'MutatingWebhookConfiguration',
+                    self._build_policy_mutating())
+        self._apply(VERIFY_MUTATING_NAME, 'MutatingWebhookConfiguration',
+                    self._build_verify_mutating())
         self._update_policy_statuses(policies)
+
+    #: kyverno.io policy CRs (reference: webhook/controller.go:62
+    #: policyRule) and the health lease (:67 verifyRule)
+    _POLICY_RULE = {'apiGroups': ['kyverno.io'],
+                    'apiVersions': ['v1', 'v2beta1'],
+                    'resources': ['clusterpolicies/*', 'policies/*']}
+    _VERIFY_RULE = {'apiGroups': ['coordination.k8s.io'],
+                    'apiVersions': ['v1'], 'resources': ['leases']}
+
+    def _build_policy_validating(self) -> dict:
+        """reference: controller.go:569
+        buildPolicyValidatingWebhookConfiguration"""
+        return {
+            'apiVersion': 'admissionregistration.k8s.io/v1',
+            'kind': 'ValidatingWebhookConfiguration',
+            'metadata': {'name': POLICY_VALIDATING_NAME,
+                         'labels': dict(MANAGED_BY_LABELS)},
+            'webhooks': [{
+                'name': 'validate-policy.kyverno.svc',
+                'clientConfig': self._client_config('/policyvalidate'),
+                'rules': [dict(self._POLICY_RULE,
+                               operations=['CREATE', 'UPDATE'])],
+                'failurePolicy': 'Fail',
+                'sideEffects': 'None',
+                'admissionReviewVersions': ['v1'],
+            }],
+        }
+
+    def _build_policy_mutating(self) -> dict:
+        """reference: controller.go:548
+        buildPolicyMutatingWebhookConfiguration"""
+        return {
+            'apiVersion': 'admissionregistration.k8s.io/v1',
+            'kind': 'MutatingWebhookConfiguration',
+            'metadata': {'name': POLICY_MUTATING_NAME,
+                         'labels': dict(MANAGED_BY_LABELS)},
+            'webhooks': [{
+                'name': 'mutate-policy.kyverno.svc',
+                'clientConfig': self._client_config('/policymutate'),
+                'rules': [dict(self._POLICY_RULE,
+                               operations=['CREATE', 'UPDATE'])],
+                'failurePolicy': 'Fail',
+                'sideEffects': 'NoneOnDryRun',
+                'reinvocationPolicy': 'IfNeeded',
+                'admissionReviewVersions': ['v1'],
+            }],
+        }
+
+    def _build_verify_mutating(self) -> dict:
+        """reference: controller.go:521
+        buildVerifyMutatingWebhookConfiguration"""
+        return {
+            'apiVersion': 'admissionregistration.k8s.io/v1',
+            'kind': 'MutatingWebhookConfiguration',
+            'metadata': {'name': VERIFY_MUTATING_NAME,
+                         'labels': dict(MANAGED_BY_LABELS)},
+            'webhooks': [{
+                'name': 'monitor-webhooks.kyverno.svc',
+                'clientConfig': self._client_config('/verifymutate'),
+                'rules': [dict(self._VERIFY_RULE, operations=['UPDATE'])],
+                'failurePolicy': 'Ignore',
+                'sideEffects': 'NoneOnDryRun',
+                'reinvocationPolicy': 'IfNeeded',
+                'admissionReviewVersions': ['v1'],
+                'objectSelector': {'matchLabels': {
+                    'app.kubernetes.io/name': 'kyverno'}},
+            }],
+        }
 
     def _build_validating(self, policies: List[Policy]) -> dict:
         kinds = _policy_kinds(
@@ -139,10 +221,27 @@ class WebhookConfigReconciler:
                 'admissionReviewVersions': ['v1'],
                 'timeoutSeconds': self.timeout,
             })
+        if not webhooks:
+            # no policies installed: the default catch-all ignore webhook
+            # (reference: controller.go
+            # buildDefaultResourceValidatingWebhookConfiguration)
+            webhooks.append({
+                'name': 'validate.kyverno.svc-ignore',
+                'clientConfig': self._client_config('/validate/ignore'),
+                'rules': [{'apiGroups': ['*'], 'apiVersions': ['*'],
+                           'resources': ['*/*'],
+                           'operations': ['CREATE', 'UPDATE', 'DELETE',
+                                          'CONNECT']}],
+                'failurePolicy': 'Ignore',
+                'sideEffects': 'NoneOnDryRun',
+                'admissionReviewVersions': ['v1'],
+                'timeoutSeconds': self.timeout,
+            })
         return {
             'apiVersion': 'admissionregistration.k8s.io/v1',
             'kind': 'ValidatingWebhookConfiguration',
-            'metadata': {'name': VALIDATING_NAME},
+            'metadata': {'name': VALIDATING_NAME,
+                         'labels': dict(MANAGED_BY_LABELS)},
             'webhooks': webhooks,
         }
 
@@ -165,10 +264,25 @@ class WebhookConfigReconciler:
                 'admissionReviewVersions': ['v1'],
                 'timeoutSeconds': self.timeout,
             })
+        if not webhooks:
+            # reference: controller.go
+            # buildDefaultResourceMutatingWebhookConfiguration
+            webhooks.append({
+                'name': 'mutate.kyverno.svc-ignore',
+                'clientConfig': self._client_config('/mutate/ignore'),
+                'rules': [{'apiGroups': ['*'], 'apiVersions': ['*'],
+                           'resources': ['*/*'],
+                           'operations': ['CREATE', 'UPDATE']}],
+                'failurePolicy': 'Ignore',
+                'sideEffects': 'NoneOnDryRun',
+                'admissionReviewVersions': ['v1'],
+                'timeoutSeconds': self.timeout,
+            })
         return {
             'apiVersion': 'admissionregistration.k8s.io/v1',
             'kind': 'MutatingWebhookConfiguration',
-            'metadata': {'name': MUTATING_NAME},
+            'metadata': {'name': MUTATING_NAME,
+                         'labels': dict(MANAGED_BY_LABELS)},
             'webhooks': webhooks,
         }
 
@@ -194,15 +308,36 @@ class WebhookConfigReconciler:
 
     def _update_policy_statuses(self, policies: List[Policy]) -> None:
         """Mark policies ready once their webhooks exist, persisting the
-        Ready condition to the live CR the way the reference's status
-        subresource update does (controller.go:426 updatePolicyStatuses;
-        condition shape: api/kyverno/v1 IsReady/SetReady)."""
-        status = {
-            'ready': True,
-            'conditions': [{'type': 'Ready', 'status': 'True',
-                            'reason': 'Succeeded'}],
-        }
+        Ready condition, the computed autogen rules and the per-type
+        rule counts to the live CR the way the reference's status
+        subresource update does (controller.go:426 updatePolicyStatuses
+        + utils.go:111 setRuleCount; condition shape: api/kyverno/v1
+        IsReady/SetReady)."""
+        from ..autogen.autogen import compute_rules
         for policy in policies:
+            rules = compute_rules(policy)
+            counts = {'validate': 0, 'generate': 0, 'mutate': 0,
+                      'verifyimages': 0}
+            autogen_rules = []
+            for rule in rules:
+                if str(rule.get('name', '')).startswith('autogen-'):
+                    autogen_rules.append(rule)
+                    continue
+                if rule.get('validate') is not None:
+                    counts['validate'] += 1
+                if rule.get('generate') is not None:
+                    counts['generate'] += 1
+                if rule.get('mutate') is not None:
+                    counts['mutate'] += 1
+                if rule.get('verifyImages') is not None:
+                    counts['verifyimages'] += 1
+            status = {
+                'ready': True,
+                'conditions': [{'type': 'Ready', 'status': 'True',
+                                'reason': 'Succeeded'}],
+                'autogen': {'rules': autogen_rules},
+                'rulecount': counts,
+            }
             policy.raw.setdefault('status', {}).update(status)
             kind = policy.raw.get('kind', 'ClusterPolicy')
             api_version = policy.raw.get('apiVersion', 'kyverno.io/v1')
@@ -210,10 +345,8 @@ class WebhookConfigReconciler:
                 live = self.client.get_resource(
                     api_version, kind, policy.namespace or '', policy.name)
                 live_status = live.get('status') or {}
-                if live_status.get('ready') and \
-                        live_status.get('conditions') == \
-                        status['conditions']:
-                    continue  # already Ready: no steady-state writes
+                if all(live_status.get(k) == v for k, v in status.items()):
+                    continue  # already current: no steady-state writes
                 live.setdefault('status', {}).update(status)
                 self.client.update_status_resource(
                     api_version, kind, policy.namespace or '', live)

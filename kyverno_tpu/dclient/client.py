@@ -125,6 +125,18 @@ class FakeClient:
             ns = namespace
         obj.setdefault('apiVersion', api_version)
         obj.setdefault('kind', kind)
+        if obj['kind'] == 'Namespace':
+            # the API server stamps this immutable label on every
+            # namespace (k8s NamespaceDefaultLabelName); policies rely
+            # on it for namespaceSelector matching
+            meta.setdefault('labels', {}).setdefault(
+                'kubernetes.io/metadata.name', name)
+        if obj['kind'] == 'Secret' and obj.get('stringData'):
+            # the API server folds stringData into base64 data on write
+            import base64 as _b64
+            data = obj.setdefault('data', {})
+            for k, v in obj.pop('stringData').items():
+                data[k] = _b64.b64encode(str(v).encode()).decode()
         key = _key(obj['apiVersion'], obj['kind'], ns if kind != 'Namespace' else '', name)
         with self._lock:
             if key in self._store:

@@ -204,6 +204,19 @@ def check_kind(kinds: List[str], resource: Resource,
                     api_resource.get('kind', '') == resource.kind):
                 return True
             continue
+        from ..api.unstructured import split_subresource
+        parent_kind, sub = split_subresource(kind)
+        if sub:
+            # cluster path for 'Parent/subresource' rule kinds: the
+            # review carries the subresource name and the parent kind
+            # (reference: pkg/utils/match/kind.go CheckKind resolving
+            # via the discovery subresource map)
+            if parent_kind == resource.kind and \
+                    subresource_in_review.lower() == sub.lower():
+                if not gv or group_version_matches(gv,
+                                                   resource.group_version):
+                    return True
+            continue
         result = kind == resource.kind and (
             subresource_in_review == '' or
             (allow_ephemeral and subresource_in_review == 'ephemeralcontainers'))

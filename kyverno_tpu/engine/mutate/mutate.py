@@ -197,8 +197,13 @@ def mutate(engine, pctx: PolicyContext) -> EngineResponse:
 
             if mutate_resp.patched_resource is not None:
                 matched_resource = mutate_resp.patched_resource
+            message = mutate_resp.message
+            if mutate_resp.status == RuleStatus.PASS:
+                # reference: mutation.go:334 buildRuleResponse →
+                # :347 buildSuccessMessage
+                message = _success_message(mutate_resp.patched_resource)
             rule_resp = RuleResponse(rule.name, RuleType.MUTATION,
-                                     mutate_resp.message, mutate_resp.status,
+                                     message, mutate_resp.status,
                                      patches=mutate_resp.patches)
             rule_resp.processing_time = time.time() - rule_start
             resp.policy_response.rules.append(rule_resp)
@@ -219,6 +224,19 @@ def mutate(engine, pctx: PolicyContext) -> EngineResponse:
     resp.patched_resource = matched_resource
     engine._build_response(pctx, resp, start)
     return resp
+
+
+def _success_message(patched: Optional[dict]) -> str:
+    """reference: pkg/engine/mutation.go:347 buildSuccessMessage"""
+    if not patched:
+        return 'mutated resource'
+    meta = patched.get('metadata') or {}
+    kind = patched.get('kind', '')
+    name = meta.get('name', '')
+    ns = meta.get('namespace', '')
+    if not ns:
+        return f'mutated {kind}/{name}'
+    return f'mutated {kind}/{name} in namespace {ns}'
 
 
 def _mutate_resource(rule: Rule, pctx: PolicyContext,

@@ -143,7 +143,10 @@ def _traverse(element: Any, document: Any, path: str,
             new_key = leaf_action(key, document, path)
             if not isinstance(new_key, str):
                 new_key = key
-            out[new_key] = _traverse(value, document, f'{path}/{key}',
+            # JSON-pointer escaping: a key containing '/' (label/
+            # annotation domains) must stay one path component
+            esc = str(key).replace('~', '~0').replace('/', '~1')
+            out[new_key] = _traverse(value, document, f'{path}/{esc}',
                                      leaf_action)
         return out
     if isinstance(element, list):
@@ -214,7 +217,8 @@ def _at_to_path(ctx: Optional[Context], path: str) -> str:
                 prefix = 'target'
         except (ContextError, InvalidVariableError):
             pass
-    parts = [p for p in path.split('/') if p != '']
+    parts = [p.replace('~1', '/').replace('~0', '~')
+             for p in path.split('/') if p != '']
     # skip past "foreach" if present, then the leading two elements
     if 'foreach' in parts:
         parts = parts[parts.index('foreach') + 1:]
@@ -225,6 +229,11 @@ def _at_to_path(ctx: Optional[Context], path: str) -> str:
             if segments:
                 segments[-1] = f'{segments[-1]}[{p}]'
         else:
+            if not re.fullmatch(r'[A-Za-z_][A-Za-z0-9_]*', p):
+                # quoted identifier for keys JMESPath cannot take bare
+                # (reference: pkg/utils/jsonpointer/pointer.go:139
+                # JMESPath())
+                p = '"' + p.replace('\\', '\\\\').replace('"', '\\"') + '"'
             segments.append(p)
     return '.'.join(segments)
 
@@ -299,7 +308,9 @@ def _form_absolute_path(reference_path: str, absolute_path: str) -> str:
 def _get_value_by_pointer(document: Any, pointer: str) -> Any:
     from .anchor import remove_anchor
     cur = document
-    for part in [p for p in pointer.split('/') if p]:
+    # traversal paths are JSON-pointer escaped (~1 = '/', ~0 = '~')
+    for part in [p.replace('~1', '/').replace('~0', '~')
+                 for p in pointer.split('/') if p]:
         if isinstance(cur, dict):
             if part in cur:
                 cur = cur[part]

@@ -59,6 +59,75 @@ def events_for_response(response: EngineResponse,
     return out
 
 
+def _policy_ref(response: EngineResponse) -> dict:
+    pr = response.policy_response
+    policy = getattr(response, 'policy', None)
+    kind = (policy.raw.get('kind') if policy is not None and
+            getattr(policy, 'raw', None) else None) or \
+        ('Policy' if pr.policy_namespace else 'ClusterPolicy')
+    ref = {'apiVersion': 'kyverno.io/v1', 'kind': kind,
+           'name': pr.policy_name}
+    if pr.policy_namespace:
+        ref['namespace'] = pr.policy_namespace
+    return ref
+
+
+def _resource_label(pr) -> str:
+    if pr.resource_namespace:
+        return (f'{pr.resource_kind} {pr.resource_namespace}/'
+                f'{pr.resource_name}')
+    return f'{pr.resource_kind} {pr.resource_name}'
+
+
+def events_for_responses(responses: List[EngineResponse],
+                         blocked: bool = False,
+                         source: str = SOURCE_ADMISSION) -> List[dict]:
+    """Admission-chain event generation, reference-faithful: failures
+    raise PolicyViolation events on the POLICY (plus, when not blocked,
+    violation events on the resource); full success raises a Normal
+    PolicyApplied event on the policy (reference:
+    pkg/webhooks/utils/event.go:11 GenerateEvents +
+    pkg/event/events.go:12 NewPolicyFailEvent, :50
+    NewPolicyAppliedEvent)."""
+    out: List[dict] = []
+    for er in responses:
+        pr = er.policy_response
+        if not pr.rules:
+            continue
+        statuses = [r.status for r in pr.rules]
+        failed = any(s in (RuleStatus.FAIL, RuleStatus.ERROR)
+                     for s in statuses)
+        if failed:
+            res_ref = {'kind': pr.resource_kind,
+                       'namespace': pr.resource_namespace,
+                       'name': pr.resource_name,
+                       'apiVersion': pr.resource_api_version}
+            for rule in pr.rules:
+                if rule.status not in (RuleStatus.FAIL, RuleStatus.ERROR):
+                    continue
+                # reference: events.go:23 buildPolicyEventMessage
+                msg = f'{_resource_label(pr)}: [{rule.name}] {rule.status}'
+                if blocked:
+                    msg += ' (blocked)'
+                if rule.status == RuleStatus.ERROR and rule.message:
+                    msg += f'; {rule.message}'
+                ev = new_event(_policy_ref(er), REASON_POLICY_VIOLATION,
+                               msg, source)
+                out.append(ev)
+                if not blocked:
+                    out.append(new_event(
+                        res_ref, REASON_POLICY_VIOLATION,
+                        f'policy {pr.policy_name}/{rule.name} '
+                        f'{rule.status}: {rule.message}', source))
+        elif all(s == RuleStatus.SKIP for s in statuses):
+            continue  # skipped: no event (exceptions handled upstream)
+        else:
+            out.append(new_event(
+                _policy_ref(er), REASON_POLICY_APPLIED,
+                f'{_resource_label(pr)}: pass', source))
+    return out
+
+
 class EventGenerator:
     """Buffered event emitter (reference: pkg/event/controller.go)."""
 

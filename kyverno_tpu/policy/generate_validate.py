@@ -101,14 +101,61 @@ class GenerateValidator:
         return can_i_generate_error(self.auth, kind, namespace)
 
 
-def validate_generate_rule(rule: dict, index: int,
-                           client=None) -> Optional[str]:
+_CLUSTER_SCOPED_KINDS = {
+    'Namespace', 'Node', 'ClusterRole', 'ClusterRoleBinding',
+    'CustomResourceDefinition', 'ClusterPolicy', 'PriorityClass',
+    'StorageClass', 'PersistentVolume', 'ValidatingWebhookConfiguration',
+    'MutatingWebhookConfiguration',
+}
+
+
+def _check_namespaced_generate(rule: dict, generation: dict,
+                               policy_namespace: str) -> Optional[str]:
+    """A namespaced Policy may only generate into its own namespace
+    (reference: pkg/policy/validate.go:1115-1140)."""
+    name = rule.get('name', '')
+    kind = generation.get('kind', '')
+    if kind and kind in _CLUSTER_SCOPED_KINDS:
+        return (f'path: spec.rules[{name}]: a namespaced policy cannot '
+                f'generate cluster-wide resources')
+    target_ns = generation.get('namespace', '')
+    if kind and not is_variable(target_ns) and \
+            target_ns != policy_namespace:
+        return (f'path: spec.rules[{name}]: a namespaced policy cannot '
+                f'generate resources in other namespaces, expected: '
+                f'{policy_namespace}, received: {target_ns}')
+    clone = generation.get('clone') or {}
+    if clone.get('name'):
+        clone_ns = clone.get('namespace', '')
+        if not is_variable(clone_ns) and clone_ns != policy_namespace:
+            return (f'path: spec.rules[{name}]: a namespaced policy '
+                    f'cannot clone resources to or from other '
+                    f'namespaces, expected: {policy_namespace}, '
+                    f'received: {clone_ns}')
+    clone_list = generation.get('cloneList') or {}
+    if clone_list.get('kinds'):
+        cl_ns = clone_list.get('namespace', '')
+        if not is_variable(cl_ns) and cl_ns != policy_namespace:
+            return (f'path: spec.rules[{name}]: a namespaced policy '
+                    f'cannot clone resources to or from other '
+                    f'namespaces, expected: {policy_namespace}, '
+                    f'received: {cl_ns}')
+    return None
+
+
+def validate_generate_rule(rule: dict, index: int, client=None,
+                           policy_namespace: str = '') -> Optional[str]:
     """Validate one rule's generate action; returns an error string or
     None (reference: pkg/policy/actions.go:24 validateActions — mock mode
     when no client is supplied)."""
     generation = rule.get('generate')
     if generation is None:
         return None
+    if policy_namespace:
+        err = _check_namespaced_generate(rule, generation,
+                                         policy_namespace)
+        if err is not None:
+            return err
     auth = Auth(client) if client is not None else FakeAuth()
     path, err = GenerateValidator(generation, auth).validate()
     if err is not None:

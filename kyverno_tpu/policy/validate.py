@@ -98,14 +98,16 @@ def validate_policy(doc: dict, client=None) -> List[str]:
             _validate_mutate_rule(rule['mutate'], f'{path}.mutate')
         if rule.get('generate') is not None:
             from .generate_validate import validate_generate_rule
-            err = validate_generate_rule(rule, i, client)
+            policy_ns = (doc.get('metadata') or {}).get('namespace', '') \
+                if doc.get('kind') == 'Policy' else ''
+            err = validate_generate_rule(rule, i, client, policy_ns)
             if err is not None:
                 raise PolicyValidationError(err)
         _validate_conditions_shape(rule.get('preconditions'),
                                    f'{path}.preconditions')
         if background:
-            _check_background_vars(rule, path)
-        _check_wildcard_kinds(rule, path)
+            _check_background_vars(rule, path, i)
+        _check_wildcard_kinds(rule, path, background=bool(background))
     return warnings
 
 
@@ -204,28 +206,78 @@ def _iter_strings(node: Any):
             yield from _iter_strings(v)
 
 
-def _check_background_vars(rule: dict, path: str) -> None:
-    """Background policies cannot depend on admission-only variables
-    (reference: pkg/policy/background.go:21 ContainsVariablesOtherThanObject)."""
+_FORBIDDEN_BACKGROUND_VARS = [
+    re.compile(p) for p in (
+        r'(?:^|[^.])(serviceAccountName)\b',
+        r'(?:^|[^.])(serviceAccountNamespace)\b',
+        r'(?:^|[^.])(request\.userInfo)',
+        r'(?:^|[^.])(request\.roles)',
+        r'(?:^|[^.])(request\.clusterRoles)',
+    )]
+
+
+def _userinfo_field(block: Any) -> str:
+    f = block or {}
+    for key in ('roles', 'clusterRoles', 'subjects'):
+        if f.get(key):
+            return key
+    return ''
+
+
+def _check_background_vars(rule: dict, path: str, idx: int = 0) -> None:
+    """Background policies cannot filter on user info or reference
+    admission-only variables (reference: pkg/policy/background.go:20
+    containsUserVariables + :42 hasUserMatchExclude)."""
+    for block_name in ('match', 'exclude'):
+        block = rule.get(block_name) or {}
+        p = _userinfo_field(block)
+        if p:
+            raise PolicyValidationError(
+                f'invalid variable used at path: '
+                f'spec/rules[{idx}]/{block_name}/{p}')
+        for sub in ('any', 'all'):
+            for i, f in enumerate(block.get(sub) or []):
+                p = _userinfo_field(f)
+                if p:
+                    raise PolicyValidationError(
+                        f'invalid variable used at path: '
+                        f'spec/rules[{idx}]/{block_name}/{sub}[{i}]/{p}')
+    # mutate-existing rules legitimately reference the admission request
+    # (reference: background.go:28)
+    if (rule.get('mutate') or {}).get('targets'):
+        return
     for s in _iter_strings(rule):
         for m in RE_VARIABLES.finditer(s):
-            var = m.group(2).replace('{{', '').replace('}}', '').strip()
-            if var.startswith(('request.userInfo', 'request.roles',
-                               'request.clusterRoles')):
-                raise PolicyValidationError(
-                    f'{path}: invalid variable used at path: {var} — '
-                    f'only select variables are allowed in background '
-                    f'mode. Set spec.background=false to disable '
-                    f'background mode for this policy.')
+            var = m.group(2)  # the {{...}} form, as the reference reports
+            for banned in _FORBIDDEN_BACKGROUND_VARS:
+                if banned.search(var):
+                    raise PolicyValidationError(
+                        f'variable {var} is not allowed')
 
 
-def _check_wildcard_kinds(rule: dict, path: str) -> None:
+def _check_wildcard_kinds(rule: dict, path: str,
+                          background: bool = True) -> None:
     """Wildcard kinds restrict the usable features
-    (reference: validate.go wildcard checks)."""
+    (reference: pkg/policy/validate.go:1192 validateWildcard)."""
     kinds = []
     match = rule.get('match') or {}
     for f in [match] + (match.get('any') or []) + (match.get('all') or []):
         kinds.extend((f.get('resources') or {}).get('kinds') or [])
+    if '*' in [str(k) for k in kinds]:
+        if background:
+            raise PolicyValidationError(
+                'wildcard policy not allowed in background mode. Set '
+                'spec.background=false to disable background mode for '
+                'this policy rule')
+        if len(kinds) > 1:
+            raise PolicyValidationError(
+                'wildard policy can not deal more than one kind')
+        validate = rule.get('validate') or {}
+        if rule.get('generate') is not None or \
+                rule.get('verifyImages') is not None or \
+                validate.get('foreach') is not None:
+            raise PolicyValidationError(
+                'wildcard policy does not support rule type')
     if any('*' in str(k) for k in kinds):
         validate = rule.get('validate') or {}
         if validate.get('pattern') is not None or \

@@ -97,7 +97,8 @@ class AggregateController:
             'name': owner.get('name', ''),
             'uid': owner.get('uid', ''),
         }
-        for result in report.get('results') or []:
+        from .results import get_results
+        for result in get_results(report):
             entry = policy_map.get(result.get('policy', ''))
             if entry is None or result.get('rule', '') not in entry[1]:
                 continue

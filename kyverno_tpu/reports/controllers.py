@@ -179,15 +179,98 @@ class BackgroundScanController:
             return []
         now = time.time()
         # stream: report construction + CR writes overlap the next
-        # chunk's encode/transfer/device stages
+        # chunk's encode/transfer/device stages.  PolicyExceptions are
+        # rare and rule-targeted; when any exist the host engine decides
+        # (exception semantics: pkg/engine/validation.go:826
+        # hasPolicyExceptions — the compiled path has no exception lanes)
+        exceptions = self._list_exceptions()
         reports = []
-        for uid, resource, responses in zip(
-                uids, work, self.scanner.scan_stream(work)):
-            report = self._store_report(uid, resource, responses, now)
+        if exceptions:
+            stream = self._host_scan(work, exceptions)
+            for uid, resource, responses in zip(uids, work, stream):
+                report = self._store_report(uid, resource, responses, now)
+                self._scanned[uid] = (calculate_resource_hash(resource),
+                                      now)
+                if report is not None:
+                    reports.append(report)
+            return reports
+        # fused fast path: report results assembled straight from the
+        # device cells (bit-identity pinned by tests/test_report_fusion)
+        for uid, resource, row in zip(
+                uids, work, self.scanner.scan_report_results(work, now)):
+            report = self._store_fused_report(uid, resource, row, now)
             self._scanned[uid] = (calculate_resource_hash(resource), now)
             if report is not None:
                 reports.append(report)
         return reports
+
+    def _store_fused_report(self, uid: str, resource: dict, row,
+                            now: float) -> Optional[dict]:
+        from .results import set_fused_results
+        results, summary, row_policies = row
+        meta = resource.get('metadata') or {}
+        ns = meta.get('namespace', '')
+        report = new_background_scan_report(resource)
+        if not report['metadata'].get('name'):
+            report['metadata']['name'] = uid.replace('/', '-').lower()
+        set_resource_version_labels(report, resource)
+        report.setdefault('metadata', {}).setdefault('annotations', {})[
+            ANNOTATION_LAST_SCAN_TIME] = _rfc3339(now)
+        set_fused_results(report, results, summary, row_policies)
+        return self._write_report(report, ns)
+
+    def _write_report(self, report: dict, ns: str) -> Optional[dict]:
+        from .results import get_results
+        existing = None
+        try:
+            existing = self.client.get_resource(
+                'kyverno.io/v1alpha2', report['kind'], ns,
+                report['metadata']['name'])
+        except Exception:  # noqa: BLE001
+            existing = None
+        if not get_results(report):
+            # no policy produced a result (e.g. the policy set shrank):
+            # an empty report is deleted, not kept around (reference:
+            # report/background/controller.go reconcileReport)
+            if existing is not None:
+                try:
+                    self.client.delete_resource(
+                        'kyverno.io/v1alpha2', report['kind'], ns,
+                        report['metadata']['name'])
+                except Exception:  # noqa: BLE001
+                    pass
+            return None
+        if existing is not None:
+            existing.update({k: report[k]
+                             for k in ('metadata', 'spec', 'results',
+                                       'summary') if k in report})
+            return self.client.update_resource(
+                'kyverno.io/v1alpha2', report['kind'], ns, existing)
+        return self.client.create_resource(
+            'kyverno.io/v1alpha2', report['kind'], ns, report)
+
+    def _list_exceptions(self) -> List[dict]:
+        if self.client is None:
+            return []
+        out: List[dict] = []
+        for api_version in ('kyverno.io/v2alpha1', 'kyverno.io/v2beta1'):
+            try:
+                out += self.client.list_resource(api_version,
+                                                 'PolicyException')
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+    def _host_scan(self, work: List[dict], exceptions: List[dict]):
+        from ..engine.api import PolicyContext
+        for doc in work:
+            responses = []
+            for policy in self.policies:
+                pctx = PolicyContext(policy, new_resource=doc,
+                                     exceptions=exceptions)
+                responses.append(
+                    self.engine.apply_background_checks(pctx))
+            yield responses
 
     def _store_report(self, uid: str, resource: dict, responses,
                       now: float) -> Optional[dict]:
@@ -203,21 +286,7 @@ class BackgroundScanController:
             ANNOTATION_LAST_SCAN_TIME] = _rfc3339(now)
         relevant = [r for r in responses if r.policy_response.rules]
         set_responses(report, *relevant)
-        existing = None
-        try:
-            existing = self.client.get_resource(
-                'kyverno.io/v1alpha2', report['kind'], ns,
-                report['metadata']['name'])
-        except Exception:  # noqa: BLE001
-            existing = None
-        if existing is not None:
-            existing.update({k: report[k]
-                             for k in ('metadata', 'spec', 'results',
-                                       'summary') if k in report})
-            return self.client.update_resource(
-                'kyverno.io/v1alpha2', report['kind'], ns, existing)
-        return self.client.create_resource(
-            'kyverno.io/v1alpha2', report['kind'], ns, report)
+        return self._write_report(report, ns)
 
 
 class AdmissionReportController:
@@ -244,23 +313,46 @@ class AdmissionReportController:
                     continue  # unlabeled reports are not dedup candidates
                 by_uid.setdefault(uid, []).append(report)
             for uid, group in by_uid.items():
-                if len(group) <= 1:
-                    continue
                 group.sort(key=lambda r: (r.get('metadata') or {}).get(
                     'creationTimestamp', ''))
                 primary = group[0]
-                results = list(primary.get('results') or [])
+                from .results import (calculate_summary, get_results,
+                                      sort_report_results)
+                results = list(get_results(primary))
                 for extra in group[1:]:
-                    results.extend(extra.get('results') or [])
+                    results.extend(get_results(extra))
                     ns = (extra.get('metadata') or {}).get('namespace', '')
                     self.client.delete_resource(
                         'kyverno.io/v1alpha2', kind, ns,
                         (extra.get('metadata') or {}).get('name', ''))
-                from .results import calculate_summary, sort_report_results
-                sort_report_results(results)
-                primary['results'] = results
-                primary['summary'] = calculate_summary(results)
+                # aggregation stamps the owning resource ref onto every
+                # result (reference: report/admission/controller.go:131
+                # mergeReports — result.Resources = objectRefs)
+                owner_refs = (primary.get('metadata') or {}).get(
+                    'ownerReferences') or []
                 ns = (primary.get('metadata') or {}).get('namespace', '')
+                changed = len(group) > 1
+                if len(owner_refs) == 1:
+                    owner = owner_refs[0]
+                    object_ref = {
+                        'apiVersion': owner.get('apiVersion', ''),
+                        'kind': owner.get('kind', ''),
+                        'name': owner.get('name', ''),
+                    }
+                    if ns:
+                        object_ref['namespace'] = ns
+                    if owner.get('uid'):
+                        object_ref['uid'] = owner['uid']
+                    for result in results:
+                        if not result.get('resources'):
+                            result['resources'] = [object_ref]
+                            changed = True
+                if not changed:
+                    continue
+                sort_report_results(results)
+                spec = primary.setdefault('spec', {})
+                spec['results'] = results
+                spec['summary'] = calculate_summary(results)
                 self.client.update_resource(
                     'kyverno.io/v1alpha2', kind, ns, primary)
                 merged += 1

@@ -170,12 +170,43 @@ def split_results_by_policy(results: List[dict]) -> Dict[str, List[dict]]:
     return out
 
 
+def _results_in_spec(report: dict) -> bool:
+    """Intermediate kyverno.io report CRs ({Cluster,}AdmissionReport,
+    {Cluster,}BackgroundScanReport) carry results/summary under .spec
+    (reference: api/kyverno/v1alpha2/background_scan_report_types.go:62
+    SetResults → r.Spec.Results); the final wgpolicyk8s.io PolicyReports
+    keep them at top level."""
+    return str(report.get('apiVersion', '')).startswith('kyverno.io/')
+
+
+def get_results(report: dict) -> List[dict]:
+    if _results_in_spec(report):
+        return (report.get('spec') or {}).get('results') or []
+    return report.get('results') or []
+
+
 def set_results(report: dict, results: List[dict]) -> None:
     """reference: results.go:153 SetResults — sort + summary."""
     results = list(results)
     sort_report_results(results)
-    report['results'] = results
-    report['summary'] = calculate_summary(results)
+    target = report.setdefault('spec', {}) if _results_in_spec(report) \
+        else report
+    target['results'] = results
+    target['summary'] = calculate_summary(results)
+
+
+def set_fused_results(report: dict, results: List[dict], summary: dict,
+                      policies) -> None:
+    """Attach pre-built (already sorted) scan results to a report — the
+    fused-path sibling of ``set_responses`` fed by
+    BatchScanner.scan_report_results."""
+    from .types import set_policy_label
+    for policy in policies:
+        set_policy_label(report, policy)
+    target = report.setdefault('spec', {}) if _results_in_spec(report) \
+        else report
+    target['results'] = list(results)
+    target['summary'] = dict(summary)
 
 
 def set_responses(report: dict, *responses: EngineResponse,

@@ -66,12 +66,16 @@ def _yaml_scalar(s: str) -> str:
     return "'" + s.replace("'", "''") + "'"
 
 
+_CTRL_CHAR_RE = _re.compile(r'[\x00-\x1f]')
+
+
 def _dump_failures(failures: Dict[str, Dict[str, str]]) -> str:
     # multi-line / control-character scalars need real YAML escaping —
     # rare enough that the slow emitter handles the whole map then
+    search = _CTRL_CHAR_RE.search
     for rules in failures.values():
         for k, v in rules.items():
-            if any(ord(c) < 0x20 for c in k + v):
+            if search(k) or search(v):
                 return yaml.safe_dump(failures, default_flow_style=False)
     lines = []
     for pol in sorted(failures):
@@ -236,6 +240,7 @@ class ResourceHandlers:
                  namespace_labels: Optional[Callable[[str], dict]] = None,
                  audit_sink: Optional[Callable] = None,
                  ur_sink: Optional[Callable] = None,
+                 event_sink: Optional[Callable] = None,
                  registry_client=None,
                  device: bool = True,
                  openapi_manager=None,
@@ -279,9 +284,15 @@ class ResourceHandlers:
         self.pc_builder = pc_builder or admission.PolicyContextBuilder(
             configuration)
         self.configuration = configuration
+        if namespace_labels is None and client is not None:
+            # namespaceSelector match needs the live namespace's labels
+            # (reference: pkg/utils/kube GetNamespaceSelectorsFromNamespaceLister
+            # wired through the resource handlers)
+            namespace_labels = client.get_namespace_labels
         self.namespace_labels = namespace_labels or (lambda ns: {})
         self.audit_sink = audit_sink
         self.ur_sink = ur_sink
+        self.event_sink = event_sink
         self.registry_client = registry_client
         # the compiled device evaluator handles enforce validation for
         # CREATE requests; rebuilt when the cached policy set changes
@@ -483,7 +494,12 @@ class ResourceHandlers:
                 ctx = pctx.copy()
                 ctx.policy = policy
                 responses.append(self.engine.validate(ctx))
-        if block_request(responses, failure_policy):
+        blocked = block_request(responses, failure_policy)
+        if self.event_sink is not None and responses:
+            # reference: handlers.go Validate -> webhooks/utils/event.go
+            # GenerateEvents fed to the event controller
+            self.event_sink(responses, blocked)
+        if blocked:
             return admission.response(uid, False,
                                       get_blocked_messages(responses))
         # async hand-offs: audit-mode policies and generate URs
@@ -491,6 +507,18 @@ class ResourceHandlers:
             self.audit_sink(request, responses)
         if self.ur_sink is not None and generate_policies:
             self._create_update_requests(request, pctx, generate_policies)
+        if self.ur_sink is not None:
+            # mutate-existing policies ride UpdateRequests too
+            # (reference: pkg/webhooks/resource/updaterequest.go:20
+            # handleMutateExisting; DELETE triggers use the old object)
+            mutate_existing = [
+                p for p in self.cache.get_policies(pcache.MUTATE, kind, ns)
+                if any((r.raw.get('mutate') or {}).get('targets')
+                       for r in p.rules)]
+            if mutate_existing:
+                self._create_update_requests(request, pctx,
+                                             mutate_existing,
+                                             ur_type='mutate')
         warnings = get_warning_messages(responses)
         return admission.response(uid, True, '', warnings)
 
@@ -509,15 +537,20 @@ class ResourceHandlers:
             out.append(self.engine.validate(ctx))
         return out
 
-    def _create_update_requests(self, request: dict, pctx, policies) -> None:
-        """Spawn UpdateRequests for generate policies on admission
-        (reference: pkg/webhooks/resource/updaterequest.go:20)."""
+    def _create_update_requests(self, request: dict, pctx, policies,
+                                ur_type: str = 'generate') -> None:
+        """Spawn UpdateRequests for generate / mutate-existing policies
+        on admission (reference: pkg/webhooks/resource/updaterequest.go:20)."""
         resource = admission.request_resource(request)
+        if not resource and request.get('operation') == 'DELETE':
+            resource = admission.request_old_resource(request)
         r = Resource(resource)
         for policy in policies:
+            policy_key = f'{policy.namespace}/{policy.name}' \
+                if policy.namespace else policy.name
             self.ur_sink({
-                'type': 'generate',
-                'policy': policy.name,
+                'type': ur_type,
+                'policy': policy_key,
                 'resource': {
                     'kind': r.kind, 'apiVersion': r.api_version,
                     'namespace': r.namespace, 'name': r.name,
@@ -526,6 +559,15 @@ class ResourceHandlers:
                     'userInfo': request.get('userInfo') or {},
                     'admissionRequestInfo': {
                         'operation': request.get('operation', ''),
+                        # the background processors rebuild the admission
+                        # context — DELETE triggers resolve from oldObject
+                        # (reference: pkg/background/common/context.go:32)
+                        'admissionRequest': {
+                            'operation': request.get('operation', ''),
+                            'object': request.get('object'),
+                            'oldObject': request.get('oldObject'),
+                            'userInfo': request.get('userInfo') or {},
+                        },
                     },
                 },
             })
@@ -582,6 +624,16 @@ class ResourceHandlers:
             ctx = pctx.copy()
             ctx.policy = policy
             er = self.engine.mutate(ctx)
+            if not er.is_successful():
+                # a failed/errored mutate rule fails the admission —
+                # failurePolicy only covers webhook transport failures
+                # (reference: mutation.go:163 applyMutation →
+                # mutation.go:112 'mutation policy %s error')
+                failed = er.get_failed_rules()
+                return admission.response(
+                    uid, False,
+                    f'mutation policy {policy.name} error: failed to '
+                    f'apply policy {policy.name} rules {failed}')
             policy_patches = [p for rr in er.policy_response.rules
                               for p in (rr.patches or [])]
             if policy_patches:

@@ -193,8 +193,9 @@ class TestReportResults:
             pod, {'uid': 'req-1'}, resp, now=1)
         assert report['kind'] == 'AdmissionReport'
         assert report['metadata']['name'] == 'req-1'
-        assert report['summary'] == {'pass': 0, 'fail': 1, 'warn': 0,
-                                     'error': 0, 'skip': 0}
+        assert report['spec']['summary'] == {'pass': 0, 'fail': 1,
+                                             'warn': 0, 'error': 0,
+                                             'skip': 0}
         assert report['metadata']['labels'][
             'audit.kyverno.io/resource.uid'] == 'uid-1'
 

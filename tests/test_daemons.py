@@ -267,9 +267,12 @@ class TestToggles:
         rec = WebhookConfigReconciler(client, b'ca', 'kyverno')
         pol = Policy(AUDIT_POLICY)
         rec.reconcile([pol])
-        configs = client.list_resource(
+        # the toggle governs the RESOURCE webhooks; the static policy
+        # webhook keeps Fail (reference: controller.go:676 vs :569)
+        cfg = client.get_resource(
             'admissionregistration.k8s.io/v1',
-            'ValidatingWebhookConfiguration', '', None)
-        hooks = [w for c in configs for w in c.get('webhooks', [])]
+            'ValidatingWebhookConfiguration', '',
+            'kyverno-resource-validating-webhook-cfg')
+        hooks = cfg.get('webhooks', [])
         assert hooks and all(
             w.get('failurePolicy') == 'Ignore' for w in hooks)

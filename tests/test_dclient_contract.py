@@ -133,7 +133,9 @@ class TestContract:
         client.create_resource('v1', 'Namespace', '', {
             'apiVersion': 'v1', 'kind': 'Namespace',
             'metadata': {'name': 'team-a', 'labels': {'env': 'prod'}}})
-        assert client.get_namespace_labels('team-a') == {'env': 'prod'}
+        # the API server stamps kubernetes.io/metadata.name on create
+        assert client.get_namespace_labels('team-a') == {
+            'env': 'prod', 'kubernetes.io/metadata.name': 'team-a'}
         assert client.get_namespace_labels('ghost') == {}
 
     def test_group_api_resource(self, client):

@@ -59,9 +59,10 @@ for resource, responses in zip(stream_resources,
     relevant = [r for r in responses if r.policy_response.rules]
     set_responses(report, *relevant, now=0)
     # result dicts are shared flyweights: sanitize into copies
-    report['results'] = [
+    from kyverno_tpu.reports.results import get_results
+    report.setdefault('spec', {})['results'] = [
         {k: v for k, v in res.items() if k != 'timestamp'}
-        for res in report.get('results') or []]
+        for res in get_results(report)]
     report_dump.append(report)
 import hashlib
 report_hash = hashlib.sha256(
@@ -157,9 +158,10 @@ def test_two_process_distributed_scan_agrees():
         report = new_background_scan_report(resource)
         relevant = [r for r in responses if r.policy_response.rules]
         set_responses(report, *relevant, now=0)
-        report['results'] = [
+        from kyverno_tpu.reports.results import get_results
+        report.setdefault('spec', {})['results'] = [
             {k: v for k, v in res.items() if k != 'timestamp'}
-            for res in report.get('results') or []]
+            for res in get_results(report)]
         dump.append(report)
     want = hashlib.sha256(
         _json.dumps(dump, sort_keys=True).encode()).hexdigest()

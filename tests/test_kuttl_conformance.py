@@ -1,12 +1,12 @@
-"""Replays reference kuttl conformance suites (VERDICT r3 #7) against
-the in-memory cluster + real daemons via the step-replay harness
-(kyverno_tpu/conformance/kuttl.py).  Suites are consumed IN PLACE from
-the read-only reference checkout — nothing is vendored.
+"""Replay of the reference kuttl conformance corpus
+(/root/reference/test/conformance/kuttl — SURVEY.md §4) through the
+in-memory cluster + real daemons (kyverno_tpu/conformance/kuttl.py).
+Suites are consumed IN PLACE from the read-only reference checkout —
+nothing is vendored.
 
-Suites whose steps need kuttl features the harness cannot model
-(arbitrary shell, live registries) surface as skips with the reason —
-divergences are listed, never silently passed.
-"""
+Every case directory in the corpus is parametrized; directories the
+hermetic environment cannot replay are listed in DIVERGENT with the
+reason and skipped explicitly — never silently."""
 
 import os
 
@@ -17,57 +17,104 @@ from kyverno_tpu.conformance.kuttl import (KuttlFailure, Unsupported,
 
 ROOT = '/root/reference/test/conformance/kuttl'
 
-pytestmark = pytest.mark.skipif(
-    not os.path.isdir(ROOT), reason='reference kuttl corpus not present')
+#: suites this environment cannot replay, with reasons (zero-egress
+#: sandbox: no live registry; no kubelet: no exec/eviction; the
+#: harness does not execute arbitrary shell scripts)
+DIVERGENT = {
+    # live-cluster shell scripts
+    'mutate/clusterpolicy/standard/existing/mutate-existing-node-status':
+        'modifies the controller resource filters + node status via '
+        'shell scripts against a live node',
+    'mutate/clusterpolicy/standard/mutate-node-status':
+        'modifies node status via shell scripts against a live node',
+    'mutate/clusterpolicy/standard/userInfo-roles-clusterRoles':
+        'creates client certificates against a live cluster CA',
+    'validate/clusterpolicy/standard/enforce/api-initiated-pod-eviction':
+        'drives the eviction subresource via a shell script',
+    'validate/clusterpolicy/standard/enforce/block-pod-exec-requests':
+        'kubectl exec against a live kubelet',
+    # network-bound image verification (zero-egress sandbox; the
+    # signature *crypto* is covered offline by tests/test_cosign_crypto)
+    'validate/e2e/trusted-images':
+        'imageData context entry needs a live registry',
+    'verifyImages/clusterpolicy/standard/imageExtractors-complex':
+        'verifies live ghcr.io signatures',
+    'verifyImages/clusterpolicy/standard/imageExtractors-simple':
+        'verifies live ghcr.io signatures',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-1':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-2':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-3':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-4':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-counts-1':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-counts-2':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-attestations-multiple-subjects-counts-3':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-mutatedigest-verifydigest-required':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-nomutatedigest-noverifydigest-norequired':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'keyless-nomutatedigest-noverifydigest-required':
+        'keyless verification against the public Fulcio/Rekor instances',
+    'verifyImages/clusterpolicy/standard/'
+    'mutateDigest-noverifyDigest-norequired':
+        'digest mutation resolves tags against a live registry',
+    'verifyImages/clusterpolicy/standard/noconfigmap-diffimage-success':
+        'verifies live ghcr.io signatures',
+    'verifyImages/clusterpolicy/standard/'
+    'nomutateDigest-verifyDigest-norequired':
+        'verifies live ghcr.io signatures',
+}
 
-# (suite path, expected outcome):
-#   'pass'  — replays green
-#   a string — a known divergence / unsupported feature, asserted as the
-#   actual failure so silent drift is caught either way
-SUITES = [
-    # validate
-    'validate/e2e/global-anchor',
-    'validate/e2e/adding-key-to-config-map',
-    # rangeoperators
-    'rangeoperators/standard',
-    # exceptions
-    'exceptions/allows-rejects-creation',
-    'exceptions/only-for-specific-user',
-    # mutate
-    'mutate/e2e/patchesjson6902-simple',
-    'mutate/e2e/patchesJson6902-replace',
-    'mutate/e2e/simple-conditional',
-    'mutate/e2e/patchStrategicMerge-global',
-    'mutate/e2e/patchStrategicMerge-global-addifnotpresent',
-    'mutate/e2e/foreach-patchStrategicMerge-preconditions',
-    'mutate/e2e/jmespath-logic',
-    'mutate/e2e/variables-in-keys',
-    # generate
-    'generate/clusterpolicy/standard/data/sync/cpol-data-sync-create',
-    'generate/clusterpolicy/standard/data/sync/cpol-data-sync-delete-policy',
-    'generate/clusterpolicy/standard/data/nosync/'
-    'cpol-data-nosync-delete-downstream',
-    'generate/clusterpolicy/standard/clone/sync/cpol-clone-sync-create',
-    'generate/clusterpolicy/standard/clone/nosync/cpol-clone-nosync-create',
-    # reports
-    'reports/admission/test-report-admission-mode',
-    'reports/background/test-report-background-mode',
-]
+
+def _case_dirs():
+    cases = []
+    for dirpath, _dirnames, filenames in os.walk(ROOT):
+        rel = os.path.relpath(dirpath, ROOT)
+        if rel.startswith('_aaa'):
+            continue
+        if any(f[0].isdigit() and f.endswith('.yaml') for f in filenames):
+            cases.append(rel)
+    return sorted(cases)
 
 
-def _exists(rel):
-    return os.path.isdir(os.path.join(ROOT, rel))
+CASES = _case_dirs()
 
 
-@pytest.mark.parametrize('rel', [s for s in SUITES if _exists(s)])
+def test_corpus_discovered():
+    """The corpus walk must keep finding the reference suites."""
+    assert len(CASES) >= 100, CASES
+
+
+def test_divergent_paths_exist():
+    missing = [rel for rel in DIVERGENT
+               if not os.path.isdir(os.path.join(ROOT, rel))]
+    assert not missing, f'divergence list drifted: {missing}'
+
+
+@pytest.mark.parametrize('rel', CASES)
 def test_kuttl_suite(rel):
+    if rel in DIVERGENT:
+        pytest.skip(f'divergent: {DIVERGENT[rel]}')
     try:
         run_suite(os.path.join(ROOT, rel))
     except Unsupported as e:
-        pytest.skip(f'unsupported kuttl feature: {e}')
-
-
-def test_suite_paths_exist():
-    """Catch silent corpus drift: every listed suite must exist."""
-    missing = [s for s in SUITES if not _exists(s)]
-    assert not missing, f'kuttl suites missing from reference: {missing}'
+        pytest.fail(f'unsupported kuttl feature (not divergence-listed): '
+                    f'{e}')
+    except KuttlFailure as e:
+        raise AssertionError(f'{rel}: {e}') from e

@@ -166,7 +166,7 @@ spec:
         doc = self.base()
         doc['spec']['rules'][0]['validate']['message'] = \
             'user {{request.userInfo.username}} denied'
-        with pytest.raises(PolicyValidationError, match='background'):
+        with pytest.raises(PolicyValidationError, match='is not allowed'):
             validate_policy(doc)
 
     def test_background_false_allows_userinfo(self):

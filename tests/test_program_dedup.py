@@ -1,0 +1,134 @@
+"""Unique-program-space evaluation: replicated policies must produce
+host-identical responses while the device graph and readback stay
+O(unique rules).
+
+Reference scale scenario: a cluster with ~1k installed policies that are
+copies/variants of a small pack (the admission latency benchmark's
+shape).
+"""
+
+import copy
+
+import pytest
+
+from kyverno_tpu.api.policy import Policy, load_policies_from_yaml
+from kyverno_tpu.compiler.scan import BatchScanner
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+
+PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: disallow-latest
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: Enforce
+  rules:
+    - name: no-latest
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "latest tag not allowed"
+        pattern:
+          spec:
+            containers:
+              - image: "!*:latest"
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-run-as-non-root
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: check-containers
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "runAsNonRoot required"
+        anyPattern:
+          - spec:
+              securityContext:
+                runAsNonRoot: true
+          - spec:
+              containers:
+                - securityContext:
+                    runAsNonRoot: true
+"""
+
+PODS = [
+    {'apiVersion': 'v1', 'kind': 'Pod',
+     'metadata': {'name': 'good', 'namespace': 'default'},
+     'spec': {'containers': [
+         {'name': 'c', 'image': 'nginx:1.25',
+          'securityContext': {'runAsNonRoot': True}}]}},
+    {'apiVersion': 'v1', 'kind': 'Pod',
+     'metadata': {'name': 'bad', 'namespace': 'default'},
+     'spec': {'containers': [{'name': 'c', 'image': 'nginx:latest'}]}},
+    {'apiVersion': 'v1', 'kind': 'Pod',
+     'metadata': {'name': 'nonroot-missing', 'namespace': 'default'},
+     'spec': {'containers': [{'name': 'c', 'image': 'redis:7'}]}},
+]
+
+
+def replicate(policies, n):
+    out = []
+    i = 0
+    while len(out) < n:
+        for p in policies:
+            doc = copy.deepcopy(p.raw)
+            doc['metadata']['name'] = f"{doc['metadata']['name']}-r{i}"
+            out.append(Policy(doc))
+            if len(out) >= n:
+                break
+        i += 1
+    return out
+
+
+@pytest.fixture(scope='module')
+def replicated_scanner():
+    policies = replicate(load_policies_from_yaml(PACK), 40)
+    return policies, BatchScanner(policies)
+
+
+def test_unique_space_is_small(replicated_scanner):
+    _, scanner = replicated_scanner
+    ev = scanner._evaluator
+    assert ev.n_programs == 40
+    assert ev.n_uniq == 2  # one per distinct rule tree
+    assert not ev.expand_identity
+    # every program column maps back to one of the unique columns
+    assert set(ev.uniq_idx.tolist()) == {0, 1}
+
+
+def test_replicated_scan_matches_host(replicated_scanner):
+    policies, scanner = replicated_scanner
+    engine = Engine()
+    out = scanner.scan(PODS)
+    assert len(out) == len(PODS)
+    for doc, responses in zip(PODS, out):
+        got = {r.policy_response.policy_name:
+               {rr.name: (rr.status, rr.message)
+                for rr in r.policy_response.rules}
+               for r in responses if r.policy_response.rules}
+        host = {}
+        for policy in policies:
+            hr = engine.apply_background_checks(
+                PolicyContext(policy, new_resource=doc))
+            if hr.policy_response.rules:
+                host[policy.name] = {
+                    rr.name: (rr.status, rr.message)
+                    for rr in hr.policy_response.rules}
+        assert got == host, doc['metadata']['name']
+
+
+def test_fold_and_expand_roundtrip(replicated_scanner):
+    import numpy as np
+    from kyverno_tpu.ops.eval import fold_match_unique
+    _, scanner = replicated_scanner
+    ev = scanner._evaluator
+    rng = np.random.RandomState(0)
+    mm = (rng.rand(8, ev.n_programs) < 0.5).astype(np.uint8)
+    folded = fold_match_unique(mm, ev)
+    assert folded.shape == (8, ev.n_uniq)
+    for u, cols in enumerate(ev.uniq_groups):
+        assert (folded[:, u] == mm[:, cols].max(axis=1)).all()

@@ -66,8 +66,9 @@ class TestScanPipeline:
         assert len(stored) == 2
         for r in stored:
             assert ANNOTATION_LAST_SCAN_TIME in r['metadata']['annotations']
+        from kyverno_tpu.reports.results import get_results
         results = {r['metadata']['ownerReferences'][0]['name']:
-                   (r.get('results') or []) for r in stored}
+                   get_results(r) for r in stored}
         assert results['good'][0]['result'] == 'pass'
         assert results['bad'][0]['result'] == 'fail'
         # aggregate → PolicyReport
@@ -116,13 +117,15 @@ class TestAdmissionReportDedup:
                     'name': f'rep-{i}', 'namespace': 'default',
                     'creationTimestamp': f'2026-01-0{i+1}T00:00:00Z',
                     'labels': {'audit.kyverno.io/resource.uid': 'u1'}},
-                'results': [{'policy': 'p', 'rule': f'r{i}',
-                             'result': 'pass', 'source': 'kyverno'}],
+                'spec': {'results': [{'policy': 'p', 'rule': f'r{i}',
+                                      'result': 'pass',
+                                      'source': 'kyverno'}]},
             })
         ctrl = AdmissionReportController(client)
         assert ctrl.reconcile() == 1
         left = client.list_resource('kyverno.io/v1alpha2',
                                     'AdmissionReport', 'default', None)
         assert len(left) == 1
-        assert len(left[0]['results']) == 3
-        assert left[0]['summary']['pass'] == 3
+        from kyverno_tpu.reports.results import get_results
+        assert len(get_results(left[0])) == 3
+        assert left[0]['spec']['summary']['pass'] == 3

@@ -208,7 +208,7 @@ class TestPolicyAdmission:
                              json.dumps(review(doc)).encode())
         resp = json.loads(body)['response']
         assert resp['allowed'] is False
-        assert 'background' in resp['status']['message']
+        assert 'is not allowed' in resp['status']['message']
 
     def test_exception_validation(self):
         server = serve(make_cache())
