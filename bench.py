@@ -690,7 +690,8 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     # a bounded sample
     _progress('sieve sample')
     sieve_n = min(n_done, 20_000)
-    sieve_pods = [make_pod(random.Random(42), i) for i in range(sieve_n)]
+    sieve_rng = random.Random(42)
+    sieve_pods = [make_pod(sieve_rng, i) for i in range(sieve_n)]
     t3 = time.time()
     status, detail, match = scanner.scan_statuses(sieve_pods)
     sieve_s = time.time() - t3

@@ -189,7 +189,7 @@ class GenerateController:
                 self.engine.context_loader.load(rule.context, ctx,
                                                 policy_name=policy.name,
                                                 rule_name=rule.name)
-                substituted = Rule(substitute_all(ctx, copy.deepcopy(raw_rule)))
+                substituted = Rule(substitute_all(ctx, raw_rule))
                 created = self._apply_rule(substituted, pctx.new_resource,
                                            policy, ur)
             except Exception as exc:  # noqa: BLE001
@@ -510,7 +510,7 @@ def materialize_rule_offline(raw_rule: dict, pctx,
     ctx = pctx.json_context
     ctx.checkpoint()
     try:
-        rule = Rule(substitute_all(ctx, copy.deepcopy(raw_rule)))
+        rule = Rule(substitute_all(ctx, raw_rule))
     finally:
         ctx.restore()
     gen = rule.generation

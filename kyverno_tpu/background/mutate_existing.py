@@ -61,8 +61,27 @@ class MutateExistingController:
             if trigger is not None:
                 pctx = new_background_context(self.client, ur, policy, trigger)
         if pctx is not None:
+            from ..api.unstructured import Resource
+            from ..engine.match import matches_resource_description
+            from ..engine.mutate.mutate import _check_preconditions
             for raw_rule in rules:
                 rule = Rule(raw_rule)
+                # the trigger must actually select this rule before any
+                # target is touched (reference: mutate.go:80 ProcessUR →
+                # engine.Mutate, whose rule loop match/precondition-gates)
+                if matches_resource_description(
+                        Resource(pctx.new_resource), rule,
+                        pctx.admission_info, pctx.exclude_group_roles,
+                        pctx.namespace_labels,
+                        policy.namespace) is not None:
+                    continue
+                try:
+                    if not _check_preconditions(pctx, rule.preconditions):
+                        continue
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(f'{rule.name}: failed to evaluate '
+                                f'preconditions: {exc}')
+                    continue
                 errs.extend(
                     self._mutate_targets(pctx, rule, raw_rule, policy, ur))
         if errs:

@@ -91,6 +91,26 @@ class BatchApplier:
                 min(os.cpu_count() or 1,
                     int(os.environ.get('KTPU_APPLY_PROCS', '8')))
         self.processes = processes
+        # precompiled fast appliers: a mutate policy qualifies when EVERY
+        # mutate rule compiles (mutate_compile.py); per-resource shape
+        # escapes fall back to the engine, so results stay bit-identical
+        from .mutate_compile import compile_mutate_rule
+        self._fast_mutate: Dict[int, list] = {}
+        if os.environ.get('KTPU_FAST_MUTATE', '1') == '1':
+            for pi, p in enumerate(self.mutate_policies):
+                compiled = []
+                ok = True
+                for rule in p.rules:
+                    if not rule.has_mutate():
+                        continue
+                    fast = compile_mutate_rule(rule.raw)
+                    if fast is None:
+                        ok = False
+                        break
+                    compiled.append((rule, fast))
+                if ok and compiled and (p.apply_rules or 'All') != 'One' \
+                        and not p.is_namespaced:
+                    self._fast_mutate[pi] = compiled
 
     # -- match sieve --------------------------------------------------------
 
@@ -142,6 +162,13 @@ class BatchApplier:
         for pi in hits:
             policy = self.policies[pi]
             if pi < n_mut:
+                fast = self._fast_mutate.get(pi)
+                if fast is not None:
+                    out = self._apply_fast(policy, fast, patched)
+                    if out is not None:
+                        results, patched = out
+                        rule_results.extend(results)
+                        continue
                 ctx = PolicyContext(policy, new_resource=patched)
                 resp = self.engine.mutate(ctx)
                 rule_results.extend(
@@ -152,6 +179,27 @@ class BatchApplier:
             else:
                 ur_specs.append(_ur_spec(policy, patched))
         return ApplyResult(patched, rule_results, ur_specs)
+
+    def _apply_fast(self, policy: Policy, compiled, doc: dict):
+        """Run a policy's precompiled mutate appliers; None → the doc's
+        shape needs the engine loop (bit-identical fallback)."""
+        from .mutate_compile import FALLBACK
+        results = []
+        patched = doc
+        res = Resource(doc)
+        for rule, fast in compiled:
+            if matches_resource_description(
+                    res, rule, None, [], {}, '') is not None:
+                continue
+            out = fast.apply(patched)
+            if out is FALLBACK:
+                return None
+            status, message, changed, new_doc = out
+            results.append((policy.name, rule.name, str(status), message))
+            if changed:
+                patched = new_doc
+                res = Resource(patched)
+        return results, patched
 
     def apply(self, resources: List[dict],
               parallel: Optional[bool] = None) -> List[ApplyResult]:

@@ -71,8 +71,43 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
     if not rule.get('validate'):
         raise CompileError('not a validate rule')
     validate = rule['validate']
+    context_spec = None
+    context_inputs = None
     if rule.get('context'):
-        raise CompileError('context entries require the host engine')
+        # compilable when every entry is a cluster-data lookup whose
+        # value feeds NO compiled lane — the load's success/failure
+        # semantics are enforced per resource by the scanner (imageData
+        # entries stay host-side: network-bound)
+        entries = rule['context']
+        if not isinstance(entries, list):
+            raise CompileError('malformed context block')
+        for entry in entries:
+            e = entry or {}
+            if not (e.get('configMap') or e.get('apiCall') or
+                    e.get('variable')):
+                raise CompileError(
+                    'imageRegistry context entries require the host '
+                    'engine')
+        body = json.dumps({'v': validate,
+                           'p': rule.get('preconditions')})
+        for entry in entries:
+            nm = str((entry or {}).get('name', ''))
+            if nm and re.search(r'\b' + re.escape(nm) + r'\b', body):
+                raise CompileError(
+                    'context entry value feeds compiled lanes')
+        context_spec = tuple(entries)
+        # cacheable when every consumed variable is request.object-rooted
+        # (the load outcome is then a pure function of those values)
+        from ..engine.variables import RE_VARIABLES as _RV
+        exprs = []
+        cacheable = True
+        for m in _RV.finditer(json.dumps(entries)):
+            expr = m.group(2)[2:-2].strip()
+            if not expr.startswith('request.object'):
+                cacheable = False
+                break
+            exprs.append(expr)
+        context_inputs = tuple(sorted(set(exprs))) if cacheable else None
     if validate.get('manifests') is not None:
         raise CompileError('manifests rules require the host engine')
     if not isinstance(rule.get('match', {}) or {}, dict) or \
@@ -185,6 +220,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
         error_messages=tuple(error_messages), pss=pss,
         skip_message=skip_message,
         background=policy.background, rule_raw=rule,
+        context_spec=context_spec, context_inputs=context_inputs,
         fail_sites=tuple(fail_sites) if fail_sites is not None else None,
         fail_prefix=fail_prefix, deny_fail_message=deny_fail_message,
         any_fail_sites=any_fail_sites, any_fail_prefix=any_fail_prefix)

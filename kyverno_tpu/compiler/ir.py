@@ -360,6 +360,18 @@ class RuleProgram:
     # children contribute 'rule NAME[i] failed at path P' parts in order
     any_fail_sites: Optional[Tuple[Tuple[str, ...], ...]] = None
     any_fail_prefix: Optional[str] = None
+    # context entries (configMap/apiCall/variable) whose VALUES feed no
+    # compiled lane: the device decision is context-independent, but the
+    # host engine's load-failure semantics must hold — the scanner
+    # attempts the load per (resource, rule) and falls back to exact
+    # host materialization on failure (reference:
+    # pkg/engine/jsonContext.go:126 LoadContext)
+    context_spec: Optional[Tuple[dict, ...]] = None
+    # the {{...}} inputs the context spec consumes, when all are
+    # request.object-rooted: load outcomes are a pure function of these
+    # values, so the scanner memoizes per (rule, inputs) instead of
+    # re-loading per cell; None -> not cacheable (re-load per resource)
+    context_inputs: Optional[Tuple[str, ...]] = None
 
 
 @dataclass(frozen=True)

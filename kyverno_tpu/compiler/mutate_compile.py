@@ -1,0 +1,363 @@
+"""Precompiled appliers for the common bulk-mutation shapes.
+
+The engine's generic mutate loop re-substitutes and re-walks the rule
+tree per (resource, element) — correct, but 10-20x more host work than
+the mutation itself on dump-scale applies (BASELINE config 5).  This
+module compiles the three dominant shapes into direct appliers:
+
+* static ``patchStrategicMerge`` overlays of nested dicts with scalar
+  leaves and ``+(key)`` add-if-absent anchors
+* static ``patchesJson6902`` add/replace ops on object paths
+* single-entry ``foreach`` over a resource list with simple per-element
+  preconditions and a merge-by-name strategic overlay whose only
+  variable is the ``{{element.name}}`` self-reference
+
+Everything else returns ``None`` and the caller keeps the engine loop.
+Appliers may also return :data:`FALLBACK` per resource when the live
+document's shape leaves the compiled fast path (e.g. a non-dict where
+the overlay expects a map) — the caller re-runs that resource through
+the engine, so results are bit-identical by construction
+(tests/test_mutate_compile.py pins equality on randomized docs;
+reference semantics: pkg/engine/mutate/patch/strategicMergePatch.go,
+patchJSON6902.go, mutation.go ForEach).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..engine import operators
+from ..engine.api import RuleStatus
+from ..engine.jmespath import compile as jp_compile
+from ..engine.mutate.mutate import _success_message
+
+#: sentinel: this resource's shape left the compiled fast path
+FALLBACK = object()
+
+_ADD_ANCHOR_RE = re.compile(r'^\+\((.+)\)$')
+_VAR_RE = re.compile(r'\{\{(.*?)\}\}', re.DOTALL)
+
+
+class CompiledMutation:
+    """One rule's fast applier: ``apply(doc) -> (status, message,
+    changed, patched) | FALLBACK``."""
+
+    __slots__ = ('apply',)
+
+    def __init__(self, apply_fn):
+        self.apply = apply_fn
+
+
+def _static(node: Any) -> bool:
+    if isinstance(node, str):
+        return '{{' not in node and '$(' not in node
+    if isinstance(node, dict):
+        return all(_static(k) and _static(v) for k, v in node.items())
+    if isinstance(node, list):
+        return all(_static(v) for v in node)
+    return True
+
+
+# -- static strategic merge (dict paths) ------------------------------------
+
+def _compile_overlay(overlay: Any) -> Optional[List[Tuple[Tuple[str, ...],
+                                                          bool, Any]]]:
+    """Flatten a static dict overlay into (path, add_only, value) sets;
+    None when the shape is outside the fast vocabulary."""
+    if not isinstance(overlay, dict) or not _static(overlay):
+        return None
+    out: List[Tuple[Tuple[str, ...], bool, Any]] = []
+
+    def walk(node: dict, path: Tuple[str, ...]) -> bool:
+        for key, value in node.items():
+            if not isinstance(key, str):
+                return False
+            add_only = False
+            m = _ADD_ANCHOR_RE.match(key)
+            if m:
+                add_only = True
+                key = m.group(1)
+            elif '(' in key or ')' in key:
+                return False  # conditional/equality/global anchors
+            if isinstance(value, dict):
+                if add_only:
+                    return False  # +() on maps: engine semantics differ
+                if not walk(value, path + (key,)):
+                    return False
+            elif isinstance(value, (list,)):
+                return False
+            else:
+                out.append((path + (key,), add_only, value))
+        return True
+
+    if not walk(overlay, ()):
+        return None
+    return out
+
+
+def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
+    """Copy-on-write application of flattened scalar sets; returns
+    (changed, patched) or FALLBACK on a non-dict intermediate."""
+    changes = []
+    for path, add_only, value in sets:
+        cur: Any = doc
+        for part in path[:-1]:
+            if not isinstance(cur, dict):
+                return FALLBACK
+            cur = cur.get(part)
+            if cur is None:
+                break
+        leaf = path[-1]
+        if cur is None:
+            # missing intermediate maps: the merge creates the path
+            changes.append((path, value))
+            continue
+        if not isinstance(cur, dict):
+            return FALLBACK
+        if leaf in cur:
+            if not add_only and cur[leaf] != value:
+                changes.append((path, value))
+        else:
+            changes.append((path, value))
+    if not changes:
+        return False, doc
+
+    patched = dict(doc)
+    copied: Dict[Tuple[str, ...], dict] = {(): patched}
+
+    def cow(path: Tuple[str, ...]) -> Any:
+        node = copied.get(path)
+        if node is not None:
+            return node
+        parent = cow(path[:-1])
+        if not isinstance(parent, dict):
+            return None
+        child = parent.get(path[-1])
+        child = dict(child) if isinstance(child, dict) else {}
+        parent[path[-1]] = child
+        copied[path] = child
+        return child
+
+    for path, value in changes:
+        parent = cow(path[:-1])
+        if parent is None:
+            return FALLBACK
+        parent[path[-1]] = value
+    return True, patched
+
+
+def compile_strategic_merge(overlay: Any) -> Optional[CompiledMutation]:
+    sets = _compile_overlay(overlay)
+    if sets is None:
+        return None
+
+    def apply(doc: dict):
+        result = _apply_sets(doc, sets)
+        if result is FALLBACK:
+            return FALLBACK
+        changed, patched = result
+        if not changed:
+            return (RuleStatus.SKIP, 'no patches applied', False, doc)
+        return (RuleStatus.PASS, _success_message(patched), True, patched)
+
+    return CompiledMutation(apply)
+
+
+# -- static json6902 --------------------------------------------------------
+
+def compile_json6902(patch_text: Any) -> Optional[CompiledMutation]:
+    from ..engine.mutate.mutate import _load_patches_cached
+    if not isinstance(patch_text, str) or '{{' in patch_text:
+        return None
+    try:
+        ops = _load_patches_cached(patch_text)
+    except Exception:  # noqa: BLE001 - engine reports the parse error
+        return None
+    sets: List[Tuple[Tuple[str, ...], bool, Any]] = []
+    for op in ops:
+        if (op or {}).get('op') not in ('add', 'replace'):
+            return None
+        path = str(op.get('path', ''))
+        parts = tuple(p.replace('~1', '/').replace('~0', '~')
+                      for p in path.split('/') if p)
+        if not parts or any(p.isdigit() or p == '-' for p in parts):
+            return None  # array-index ops keep the engine path
+        if not _static(op.get('value')):
+            return None
+        sets.append((parts, False, op.get('value')))
+
+    def apply(doc: dict):
+        result = _apply_sets(doc, sets)
+        if result is FALLBACK:
+            return FALLBACK
+        changed, patched = result
+        if not changed:
+            return (RuleStatus.SKIP, 'no patches applied', False, doc)
+        return (RuleStatus.PASS, _success_message(patched), True, patched)
+
+    return CompiledMutation(apply)
+
+
+# -- foreach ----------------------------------------------------------------
+
+def _compile_element_conditions(conditions: Any) -> Optional[Callable]:
+    """Per-element precondition evaluator for conditions whose keys are
+    single {{element...}} JMESPath expressions and values are static."""
+    if conditions is None:
+        return lambda element: True
+    blocks: List[Tuple[str, list]] = []
+    if isinstance(conditions, dict):
+        for mode in ('all', 'any'):
+            if conditions.get(mode) is not None:
+                blocks.append((mode, conditions[mode]))
+    elif isinstance(conditions, list):
+        blocks.append(('all', conditions))
+    else:
+        return None
+    compiled_blocks = []
+    for mode, conds in blocks:
+        compiled = []
+        for cond in conds or []:
+            if not isinstance(cond, dict):
+                return None
+            key = cond.get('key')
+            if not isinstance(key, str):
+                return None
+            m = _VAR_RE.fullmatch(key.strip())
+            if not m:
+                return None
+            expr = m.group(1).strip()
+            if 'element' not in expr:
+                return None
+            value = cond.get('value')
+            if not _static(value) or not _static(cond.get('operator', '')):
+                return None
+            try:
+                searcher = jp_compile(expr)
+            except Exception:  # noqa: BLE001
+                return None
+            compiled.append((searcher, str(cond.get('operator', '')),
+                             value))
+        compiled_blocks.append((mode, compiled))
+
+    def evaluate(element: Any) -> Optional[bool]:
+        ctx = {'element': element}
+        for mode, compiled in compiled_blocks:
+            outcomes = []
+            for searcher, op, value in compiled:
+                try:
+                    key_val = searcher.search(ctx)
+                except Exception:  # noqa: BLE001 - engine decides
+                    return None
+                if key_val is None:
+                    # the engine surfaces unresolved keys as substitution
+                    # errors; anything null-ish leaves the fast path
+                    return None
+                outcomes.append(operators.evaluate(
+                    None, {'key': key_val, 'operator': op,
+                           'value': value}))
+            if mode == 'all' and not all(outcomes):
+                return False
+            if mode == 'any' and outcomes and not any(outcomes):
+                return False
+        return True
+
+    return evaluate
+
+
+def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]:
+    """Single-entry foreach over a list of named maps with an inner
+    merge-by-name overlay (the imagePullPolicy shape)."""
+    if rule.get('preconditions') is not None or \
+            not isinstance(foreach_list, list) or len(foreach_list) != 1:
+        return None
+    entry = foreach_list[0] or {}
+    if entry.get('context') or entry.get('foreach') is not None or \
+            entry.get('patchesJson6902') is not None:
+        return None
+    list_expr = entry.get('list', '')
+    if not isinstance(list_expr, str) or '{{' in list_expr:
+        return None
+    if not list_expr.startswith('request.object.'):
+        return None
+    list_path = tuple(list_expr[len('request.object.'):].split('.'))
+    cond_eval = _compile_element_conditions(entry.get('preconditions'))
+    if cond_eval is None:
+        return None
+    overlay = entry.get('patchStrategicMerge')
+    # expected shape: the list path mirrored with ONE element dict whose
+    # merge key is name: "{{element.name}}" and static scalar sets
+    node = overlay
+    for part in list_path:
+        if not isinstance(node, dict) or set(node) - {part}:
+            return None
+        node = node.get(part)
+    if not isinstance(node, list) or len(node) != 1 or \
+            not isinstance(node[0], dict):
+        return None
+    elem_overlay = dict(node[0])
+    name_ref = elem_overlay.pop('name', None)
+    if not isinstance(name_ref, str) or \
+            name_ref.replace(' ', '') != '{{element.name}}':
+        return None
+    elem_sets = _compile_overlay(elem_overlay)
+    if elem_sets is None:
+        return None
+
+    def apply(doc: dict):
+        cur: Any = doc
+        for part in list_path:
+            if not isinstance(cur, dict):
+                return FALLBACK
+            cur = cur.get(part)
+        if not isinstance(cur, list) or \
+                not all(isinstance(e, dict) for e in cur):
+            return FALLBACK
+        new_list = None
+        for i, element in enumerate(cur):
+            passed = cond_eval(element)
+            if passed is None:
+                return FALLBACK
+            if not passed:
+                continue
+            result = _apply_sets(element, elem_sets)
+            if result is FALLBACK:
+                return FALLBACK
+            changed, patched_elem = result
+            if changed:
+                if new_list is None:
+                    new_list = list(cur)
+                new_list[i] = patched_elem
+        if new_list is None:
+            # the engine's foreach reports PASS per processed entry even
+            # without patches (mutation.go ForEach apply_count)
+            return (RuleStatus.PASS, _success_message(doc), False, doc)
+        patched = dict(doc)
+        node: Any = patched
+        for part in list_path[:-1]:
+            child = dict(node[part])
+            node[part] = child
+            node = child
+        node[list_path[-1]] = new_list
+        return (RuleStatus.PASS, _success_message(patched), True, patched)
+
+    return CompiledMutation(apply)
+
+
+def compile_mutate_rule(rule: dict) -> Optional[CompiledMutation]:
+    """Fast applier for one mutate rule, or None → engine loop."""
+    if rule.get('context') or rule.get('preconditions') is not None:
+        return None
+    mutation = rule.get('mutate') or {}
+    if mutation.get('targets'):
+        return None
+    if mutation.get('foreach') is not None:
+        return compile_foreach(mutation['foreach'], rule)
+    if mutation.get('patchStrategicMerge') is not None:
+        if mutation.get('patchesJson6902'):
+            return None
+        return compile_strategic_merge(mutation['patchStrategicMerge'])
+    if mutation.get('patchesJson6902'):
+        return compile_json6902(mutation['patchesJson6902'])
+    return None

@@ -500,6 +500,10 @@ class BatchScanner:
     def _scan_inner(self, resources, contexts, admission, pctx_factory):
         n = len(resources)
         self._pctx_factory = pctx_factory
+        # context-load outcomes are memoized within one scan pass only —
+        # the host engine reloads per evaluation, so staleness must not
+        # outlive a pass
+        self._ctx_ok_cache = {}
         # admission scans evaluate every policy; the background gate
         # (engine.py:174 apply_background_checks) only applies to scans
         background_mode = admission is None and pctx_factory is None
@@ -583,7 +587,8 @@ class BatchScanner:
                         acc[k].append((prog.policy_index, None))
                         continue
                     rr = self._cell(prog, j, int(st_row[j]),
-                                    int(det_row[j]), fdet[k], ts, fly)
+                                    int(det_row[j]), fdet[k], ts, fly,
+                                    resources[start + k])
                     if rr is _HOST:
                         rr = self._materialize(prog,
                                                resources[start + k])
@@ -607,7 +612,8 @@ class BatchScanner:
                 st_col = status[rows, j].tolist()
                 det_col = detail[rows, j].tolist()
                 for k, st, det in zip(rows.tolist(), st_col, det_col):
-                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly)
+                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
+                                    resources[start + k])
                     if rr is _HOST:
                         # anchor-SKIP / HOST / unsynthesizable FAIL:
                         # re-run on the host for exact status+message
@@ -673,6 +679,7 @@ class BatchScanner:
         n = len(resources)
         now = time.time() if now is None else now
         ts = int(now)
+        self._ctx_ok_cache = {}
         wrapped = [Resource(r) for r in resources]
         match = self.match_matrix(resources, wrapped)
         host_maybe = self._host_policy_maybe(resources, wrapped)
@@ -722,7 +729,8 @@ class BatchScanner:
                 st_col = status[rows_j, j].tolist()
                 det_col = detail[rows_j, j].tolist()
                 for k, st, det in zip(rows_j.tolist(), st_col, det_col):
-                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly)
+                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
+                                    resources[start + k])
                     if rr is _HOST_MARKER:
                         rr = self._materialize(prog, resources[start + k])
                         if rr is not None:
@@ -760,13 +768,17 @@ class BatchScanner:
             start += m
 
     def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
-              fly: Dict[Tuple, Any]):
+              fly: Dict[Tuple, Any], resource: Optional[dict] = None):
         """Flyweight RuleResponse for one device cell (or _HOST_MARKER).
 
         FAIL cells key on the synthesized message — the fail-site detail
         row carries anyPattern metadata beyond column j and
         ``_fail_message_cached`` is itself memoized on the relevant
         columns."""
+        if prog.context_spec is not None and resource is not None and \
+                not self._context_ok(prog, resource):
+            # load failure must surface the host's exact error response
+            return _HOST_MARKER
         if st == STATUS_FAIL:
             msg = self._fail_message_cached(prog, j, fdet_row)
             if msg is None:
@@ -937,6 +949,48 @@ class BatchScanner:
             pctx.policy = policy
             return pctx
         return PolicyContext(policy, new_resource=resource)
+
+    def _context_ok(self, prog: RuleProgram, resource: dict) -> bool:
+        """Attempt the rule's context loads the way the host engine
+        would (reference: pkg/engine/jsonContext.go:126 LoadContext);
+        False → the cell falls back to host materialization so the
+        load-failure response is exact.  When the spec's variables are
+        all request.object-rooted, outcomes memoize on their values —
+        bulk scans then pay one load per distinct input combination."""
+        cache_key = None
+        if prog.context_inputs is not None:
+            from ..engine.jmespath import search as jp_search
+            doc_ctx = {'request': {'object': resource}}
+            try:
+                cache_key = (id(prog),) + tuple(
+                    repr(jp_search(expr, doc_ctx))
+                    for expr in prog.context_inputs)
+            except Exception:  # noqa: BLE001 - unkeyable: just load
+                cache_key = None
+            if cache_key is not None:
+                cache = getattr(self, '_ctx_ok_cache', None)
+                if cache is None:
+                    cache = self._ctx_ok_cache = {}
+                hit = cache.get(cache_key)
+                if hit is not None:
+                    return hit
+        pctx = self._pctx(self.policies[prog.policy_index], resource)
+        ctx = pctx.json_context
+        ctx.checkpoint()
+        try:
+            self.engine.context_loader.load(
+                list(prog.context_spec), ctx,
+                policy_name=prog.policy_name, rule_name=prog.rule_name)
+            ok = True
+        except Exception:  # noqa: BLE001 - exact failure via host path
+            ok = False
+        finally:
+            ctx.restore()
+        if cache_key is not None:
+            if len(self._ctx_ok_cache) > 4096:
+                self._ctx_ok_cache.clear()
+            self._ctx_ok_cache[cache_key] = ok
+        return ok
 
     def _materialize(self, prog: RuleProgram,
                      resource: dict) -> Optional[RuleResponse]:

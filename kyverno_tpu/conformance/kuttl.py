@@ -566,10 +566,29 @@ def _do_patch(cluster: KuttlCluster, argstr: str, expect_deny: bool,
     if not mp:
         raise Unsupported(f'patch without -p: {argstr[:80]!r}')
     payload = mp.group(1).strip()
-    # undo the shell quoting the corpus scripts use: \" escapes and
-    # empty-string concatenations ("" between fragments)
-    payload = payload.strip('"').replace('\\"', '"').replace('""', '')
-    doc = yaml.safe_load(payload)
+    # tiered un-quoting: try the payload as-is first (empty-string
+    # values are legitimate), then undo the corpus scripts' shell
+    # quoting (\" escapes, "" concatenation seams)
+    def _valid(d):
+        if isinstance(d, dict):
+            return True
+        return isinstance(d, list) and d and all(
+            isinstance(o, dict) and 'op' in o for o in d)
+
+    doc = None
+    for candidate in (payload.strip('"\''),
+                      payload.strip('"').replace('\\"', '"'),
+                      payload.strip('"').replace('\\"', '"')
+                      .replace('""', '')):
+        try:
+            parsed = yaml.safe_load(candidate)
+        except Exception:  # noqa: BLE001 - try the next unquoting tier
+            continue
+        if _valid(parsed):
+            doc = parsed
+            break
+    if doc is None:
+        raise Unsupported(f'unparseable patch payload: {payload[:80]!r}')
     try:
         current = cluster.client.get_resource(api_version, kind, ns, name)
     except ApiError:

@@ -49,9 +49,40 @@ def merge_patch(target: Any, patch: Any) -> Any:
     for k, v in patch.items():
         if v is None:
             target.pop(k, None)
+        elif k not in target:
+            # absent key: share the patch subtree by reference — context
+            # documents are immutable (see above), and every writer
+            # copies the target spine, so sharing is never observable.
+            # RFC 7386 still requires nested nulls to be STRIPPED, so
+            # dicts only short-circuit when verifiably null-free.
+            if isinstance(v, dict) and not _null_free(v):
+                target[k] = merge_patch(None, v)
+            else:
+                target[k] = v
         else:
-            target[k] = merge_patch(target.get(k), v)
+            target[k] = merge_patch(target[k], v)
     return target
+
+
+#: null-free memo, id-pinned: the same resource dict is merged into many
+#: contexts (one per policy/element), so the scan amortizes
+_NULL_FREE: dict = {}
+
+
+def _null_free(node: Any) -> bool:
+    if isinstance(node, dict):
+        key = id(node)
+        hit = _NULL_FREE.get(key)
+        if hit is not None and hit[0] is node:
+            return hit[1]
+        ok = all(v is not None and _null_free(v) for v in node.values())
+        if len(_NULL_FREE) > 16384:
+            _NULL_FREE.clear()
+        _NULL_FREE[key] = (node, ok)
+        return ok
+    if isinstance(node, list):
+        return all(_null_free(v) for v in node)
+    return True
 
 
 class Context:

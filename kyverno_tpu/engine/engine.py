@@ -117,7 +117,16 @@ class ContextLoader:
         if self.configmap_resolver is None:
             raise ContextError(
                 f'failed to load context entry {name}: no ConfigMap resolver')
-        data = self.configmap_resolver(cm_name, cm_ns)
+        try:
+            data = self.configmap_resolver(cm_name, cm_ns)
+        except ContextError:
+            raise
+        except Exception as e:  # noqa: BLE001 - a missing ConfigMap is a
+            # context-load failure, not an engine crash (reference:
+            # jsonContext.go:307 'failed to retrieve config map...')
+            raise ContextError(
+                f'failed to retrieve config map for context entry '
+                f'{name}: {e}')
         if data is None:
             raise ContextError(
                 f'failed to get configmap {cm_ns}/{cm_name}')

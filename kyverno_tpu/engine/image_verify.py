@@ -382,7 +382,7 @@ class ImageVerifier:
         try:
             ctx.add_json(predicate)
             try:
-                substituted = substitute_all(ctx, copy.deepcopy(conditions))
+                substituted = substitute_all(ctx, conditions)
             except Exception as exc:  # noqa: BLE001
                 return False, f'failed to substitute variables: {exc}'
             return (all(evaluate_conditions(ctx, c) for c in substituted),

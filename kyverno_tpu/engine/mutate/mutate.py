@@ -42,8 +42,9 @@ def mutate_rule(rule_raw: dict, ctx: Context, resource: dict) -> MutateResponse:
     (reference: pkg/engine/mutate/mutation.go:38 Mutate)."""
     try:
         if vars_mod.tree_has_variables(rule_raw):
-            updated_rule = vars_mod.substitute_all(
-                ctx, copy.deepcopy(rule_raw))
+            # substitute_all rebuilds every dict/list node, so the input
+            # is never aliased into the output — no pre-copy needed
+            updated_rule = vars_mod.substitute_all(ctx, rule_raw)
         else:
             # constant rule: substitution is the identity, and every
             # downstream consumer copies before mutating — skip the
@@ -70,7 +71,7 @@ def mutate_foreach_entry(name: str, foreach: dict, ctx: Context,
                          resource: dict) -> MutateResponse:
     """reference: pkg/engine/mutate/mutation.go:72 ForEach"""
     try:
-        fe = vars_mod.substitute_all(ctx, copy.deepcopy(foreach))
+        fe = vars_mod.substitute_all(ctx, foreach)
     except (SubstitutionError, ContextError, InvalidVariableError) as e:
         return _error_response('variable substitution failed', e)
     resp = _apply_patcher(fe, resource, ctx)

@@ -122,9 +122,12 @@ def substitute_all_in_preconditions(ctx: Context, document: Any) -> Any:
 
 def substitute_vars(ctx: Optional[Context], document: Any,
                     resolver: Resolver) -> Any:
+    # hoisted per call: querying request.operation per LEAF dominated
+    # bulk substitution
+    is_delete = _is_delete_request(ctx)
     return _traverse(document, document, '',
                      lambda leaf, doc, path: _substitute_vars_leaf(
-                         ctx, leaf, resolver, path))
+                         ctx, leaf, resolver, path, is_delete))
 
 
 def substitute_references(document: Any) -> Any:
@@ -133,10 +136,43 @@ def substitute_references(document: Any) -> Any:
                          leaf, doc, path))
 
 
+#: static-subtree memo for _traverse: rule trees are constants shared
+#: across resources/elements, so a subtree with no variables and no
+#: references is returned AS-IS (by reference).  Consumers treat
+#: substitution output as read-only (the same contract context documents
+#: already have), so the sharing is never observable.  The node object is
+#: pinned in the value to guard against id() reuse.
+_STATIC_TREES: dict = {}
+
+
+def _tree_static(node: Any) -> bool:
+    if isinstance(node, str):
+        return '{{' not in node and '$(' not in node
+    if isinstance(node, (int, float, bool)) or node is None:
+        return True
+    if isinstance(node, (dict, list)):
+        key = id(node)
+        hit = _STATIC_TREES.get(key)
+        if hit is not None and hit[0] is node:
+            return hit[1]
+        if isinstance(node, dict):
+            static = all(_tree_static(k) and _tree_static(v)
+                         for k, v in node.items())
+        else:
+            static = all(_tree_static(v) for v in node)
+        if len(_STATIC_TREES) > 16384:
+            _STATIC_TREES.clear()
+        _STATIC_TREES[key] = (node, static)
+        return static
+    return False
+
+
 def _traverse(element: Any, document: Any, path: str,
               leaf_action: Callable[[Any, Any, str], Any]) -> Any:
     """Walk a JSON document applying ``leaf_action`` to leaves and map keys
     (reference: pkg/engine/jsonutils/traverse.go)."""
+    if isinstance(element, (dict, list)) and _tree_static(element):
+        return element
     if isinstance(element, dict):
         out = {}
         for key, value in element.items():
@@ -156,10 +192,12 @@ def _traverse(element: Any, document: Any, path: str,
 
 
 def _substitute_vars_leaf(ctx: Optional[Context], value: Any,
-                          resolver: Resolver, path: str) -> Any:
+                          resolver: Resolver, path: str,
+                          is_delete: Optional[bool] = None) -> Any:
     if not isinstance(value, str):
         return value
-    is_delete = _is_delete_request(ctx)
+    if is_delete is None:
+        is_delete = _is_delete_request(ctx)
     variables = _find_variables(value)
     while variables:
         original_pattern = value

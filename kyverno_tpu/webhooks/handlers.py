@@ -21,6 +21,7 @@ import yaml
 from ..api.unstructured import Resource
 from ..engine.api import EngineResponse, RuleStatus
 from ..engine.engine import Engine
+from ..engine.match import matches_resource_description
 from ..policycache import cache as pcache
 from . import admission
 
@@ -511,9 +512,16 @@ class ResourceHandlers:
             # mutate-existing policies ride UpdateRequests too
             # (reference: pkg/webhooks/resource/updaterequest.go:20
             # handleMutateExisting; DELETE triggers use the old object)
+            trigger_doc = admission.request_resource(request) or \
+                admission.request_old_resource(request)
+            trigger_res = Resource(trigger_doc)
             mutate_existing = [
                 p for p in self.cache.get_policies(pcache.MUTATE, kind, ns)
-                if any((r.raw.get('mutate') or {}).get('targets')
+                if any((r.raw.get('mutate') or {}).get('targets') and
+                       matches_resource_description(
+                           trigger_res, r, pctx.admission_info,
+                           pctx.exclude_group_roles, pctx.namespace_labels,
+                           p.namespace) is None
                        for r in p.rules)]
             if mutate_existing:
                 self._create_update_requests(request, pctx,

@@ -1,0 +1,81 @@
+"""Precompiled bulk-mutation appliers must be bit-identical to the
+engine loop (statuses, messages, patched docs, UR specs) — VERDICT r4
+#4's exactness requirement."""
+
+import random
+
+import pytest
+
+import bench
+from kyverno_tpu.api.policy import load_policies_from_yaml
+from kyverno_tpu.compiler.apply import BatchApplier
+
+
+@pytest.fixture(scope='module')
+def policies():
+    return load_policies_from_yaml(bench.CONFIG5_PACK)
+
+
+def _run(policies, resources, fast, monkey):
+    monkey.setenv('KTPU_FAST_MUTATE', '1' if fast else '0')
+    applier = BatchApplier(policies, processes=0)
+    if fast:
+        assert applier._fast_mutate, 'config5 pack should fast-compile'
+    return applier.apply(resources, parallel=False)
+
+
+def test_config5_pack_compiles_fast(policies, monkeypatch):
+    monkeypatch.setenv('KTPU_FAST_MUTATE', '1')
+    applier = BatchApplier(policies, processes=0)
+    # all three mutate policies of the config-5 pack take the fast path
+    assert len(applier._fast_mutate) == 3
+
+
+def test_fast_matches_engine_bit_identical(policies, monkeypatch):
+    rng = random.Random(23)
+    resources = [bench.make_config5_resource(rng, i) for i in range(400)]
+    # shape escapes: labels as non-dict, containers missing
+    resources.append({'apiVersion': 'v1', 'kind': 'Pod',
+                      'metadata': {'name': 'weird', 'namespace': 'x',
+                                   'labels': 'not-a-dict'},
+                      'spec': {}})
+    resources.append({'apiVersion': 'v1', 'kind': 'Pod',
+                      'metadata': {'name': 'already',
+                                   'namespace': 'x',
+                                   'labels': {'managed': 'true',
+                                              'costcenter': 'c9'},
+                                   'annotations': {
+                                       'policy.io/revision': 'r1'}},
+                      'spec': {'containers': [
+                          {'name': 'c', 'image': 'i',
+                           'imagePullPolicy': 'Always'}]}})
+    fast = _run(policies, resources, True, monkeypatch)
+    slow = _run(policies, resources, False, monkeypatch)
+    assert len(fast) == len(slow)
+    for i, (f, s) in enumerate(zip(fast, slow)):
+        assert f.rule_results == s.rule_results, (
+            i, resources[i]['metadata']['name'],
+            f.rule_results, s.rule_results)
+        assert f.patched == s.patched, (
+            i, resources[i]['metadata']['name'])
+        assert f.ur_specs == s.ur_specs
+
+
+def test_fast_rate_improvement(policies, monkeypatch):
+    import time
+    rng = random.Random(7)
+    resources = [bench.make_config5_resource(rng, i) for i in range(1500)]
+    monkeypatch.setenv('KTPU_FAST_MUTATE', '1')
+    applier = BatchApplier(policies, processes=0)
+    applier.apply(resources[:32], parallel=False)
+    t0 = time.time()
+    applier.apply(resources, parallel=False)
+    fast_s = time.time() - t0
+    monkeypatch.setenv('KTPU_FAST_MUTATE', '0')
+    slow_applier = BatchApplier(policies, processes=0)
+    slow_applier.apply(resources[:32], parallel=False)
+    t0 = time.time()
+    slow_applier.apply(resources, parallel=False)
+    slow_s = time.time() - t0
+    # the precompiled path must be dramatically faster on this pack
+    assert fast_s * 3 < slow_s, (fast_s, slow_s)
