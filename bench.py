@@ -657,6 +657,10 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
                 break
         e2e_s += time.time() - t1
         n_done += slab_done
+        # slabs are ephemeral: collect the dict cycles eagerly so the
+        # north-star 1M run holds RSS flat
+        import gc
+        gc.collect()
         _progress(f'streamed {n_done} pods, {decisions} decisions, '
                   f'{e2e_s:.1f}s spent')
     peak_rss_mb = _peak_rss_mb()

@@ -76,6 +76,11 @@ class MutateExistingController:
                         policy.namespace) is not None:
                     continue
                 try:
+                    # rule context loads BEFORE preconditions, exactly
+                    # like the engine mutate loop (mutate.py:185)
+                    self.engine.context_loader.load(
+                        rule.context, pctx.json_context,
+                        policy_name=policy.name, rule_name=rule.name)
                     if not _check_preconditions(pctx, rule.preconditions):
                         continue
                 except Exception as exc:  # noqa: BLE001

@@ -97,16 +97,21 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
                     'context entry value feeds compiled lanes')
         context_spec = tuple(entries)
         # cacheable when every consumed variable is request.object-rooted
-        # (the load outcome is then a pure function of those values)
+        # AND no entry evaluates bare (un-braced) expressions per
+        # resource — 'variable' entries run a jmesPath against the full
+        # context, so their outcome can depend on more than the captured
+        # inputs (the load then re-runs per resource)
         from ..engine.variables import RE_VARIABLES as _RV
         exprs = []
-        cacheable = True
-        for m in _RV.finditer(json.dumps(entries)):
-            expr = m.group(2)[2:-2].strip()
-            if not expr.startswith('request.object'):
-                cacheable = False
-                break
-            exprs.append(expr)
+        cacheable = all((e or {}).get('configMap') or (e or {}).get('apiCall')
+                        for e in entries)
+        if cacheable:
+            for m in _RV.finditer(json.dumps(entries)):
+                expr = m.group(2)[2:-2].strip()
+                if not expr.startswith('request.object'):
+                    cacheable = False
+                    break
+                exprs.append(expr)
         context_inputs = tuple(sorted(set(exprs))) if cacheable else None
     if validate.get('manifests') is not None:
         raise CompileError('manifests rules require the host engine')

@@ -31,12 +31,19 @@ from ..engine import operators
 from ..engine.api import RuleStatus
 from ..engine.jmespath import compile as jp_compile
 from ..engine.mutate.mutate import _success_message
+from ..engine.variables import RE_VARIABLE_INIT, tree_has_variables
 
 #: sentinel: this resource's shape left the compiled fast path
 FALLBACK = object()
 
 _ADD_ANCHOR_RE = re.compile(r'^\+\((.+)\)$')
-_VAR_RE = re.compile(r'\{\{(.*?)\}\}', re.DOTALL)
+
+
+def _static(node) -> bool:
+    """No {{...}} variables / $() references anywhere in the tree —
+    the engine's own predicate, shared so the fast-mutate compiler can
+    never drift from substitution semantics."""
+    return not tree_has_variables(node)
 
 
 class CompiledMutation:
@@ -47,16 +54,6 @@ class CompiledMutation:
 
     def __init__(self, apply_fn):
         self.apply = apply_fn
-
-
-def _static(node: Any) -> bool:
-    if isinstance(node, str):
-        return '{{' not in node and '$(' not in node
-    if isinstance(node, dict):
-        return all(_static(k) and _static(v) for k, v in node.items())
-    if isinstance(node, list):
-        return all(_static(v) for v in node)
-    return True
 
 
 # -- static strategic merge (dict paths) ------------------------------------
@@ -224,10 +221,11 @@ def _compile_element_conditions(conditions: Any) -> Optional[Callable]:
             key = cond.get('key')
             if not isinstance(key, str):
                 return None
-            m = _VAR_RE.fullmatch(key.strip())
-            if not m:
-                return None
-            expr = m.group(1).strip()
+            stripped = key.strip()
+            m = RE_VARIABLE_INIT.match(stripped)
+            if not m or m.group(0) != stripped:
+                return None  # key must be exactly one {{...}} variable
+            expr = stripped[2:-2].strip()
             if 'element' not in expr:
                 return None
             value = cond.get('value')
