@@ -262,6 +262,50 @@ spec:
         assert patched['metadata']['labels']['stamped'] == 'true'
         assert patched['data'] == {'k': 'v'}
 
+    def test_non_matching_trigger_leaves_targets_alone(self):
+        """The trigger must select the rule before any target is touched
+        (reference: mutate.go ProcessUR -> engine.Mutate rule gating)."""
+        raw = yaml.safe_load(self.POLICY)
+        raw['spec']['rules'][0]['match'] = {'any': [{'resources': {
+            'kinds': ['Pod'], 'names': ['must-be-this']}}]}
+        client, ctrl, gen = _setup(yaml.dump(raw))
+        cm = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+              'metadata': {'name': 'app-config', 'namespace': 'default'},
+              'data': {'k': 'v'}}
+        client.create_resource('v1', 'ConfigMap', 'default', cm)
+        trigger = {'apiVersion': 'v1', 'kind': 'Pod',
+                   'metadata': {'name': 'other', 'namespace': 'default'},
+                   'spec': {'containers': [{'name': 'c', 'image': 'i'}]}}
+        client.create_resource('v1', 'Pod', 'default', trigger)
+        _enqueue(gen, client, 'label-configmaps', trigger, UR_MUTATE)
+        ctrl.process_pending()
+        urs = ctrl.list_urs()
+        assert urs[0].state == STATE_COMPLETED, urs[0].status
+        untouched = client.get_resource('v1', 'ConfigMap', 'default',
+                                        'app-config')
+        assert 'labels' not in untouched['metadata']
+
+    def test_failing_preconditions_leave_targets_alone(self):
+        raw = yaml.safe_load(self.POLICY)
+        raw['spec']['rules'][0]['preconditions'] = {
+            'all': [{'key': '{{request.object.metadata.name}}',
+                     'operator': 'Equals', 'value': 'only-this'}]}
+        client, ctrl, gen = _setup(yaml.dump(raw))
+        cm = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+              'metadata': {'name': 'app-config', 'namespace': 'default'},
+              'data': {'k': 'v'}}
+        client.create_resource('v1', 'ConfigMap', 'default', cm)
+        trigger = {'apiVersion': 'v1', 'kind': 'ConfigMap',
+                   'metadata': {'name': 'trigger', 'namespace': 'default'}}
+        client.create_resource('v1', 'ConfigMap', 'default', trigger)
+        _enqueue(gen, client, 'label-configmaps', trigger, UR_MUTATE)
+        ctrl.process_pending()
+        urs = ctrl.list_urs()
+        assert urs[0].state == STATE_COMPLETED, urs[0].status
+        untouched = client.get_resource('v1', 'ConfigMap', 'default',
+                                        'app-config')
+        assert 'labels' not in untouched['metadata']
+
 
 class TestBackgroundFilter:
     def test_filter_reports_pass_for_matching_generate_rule(self):
