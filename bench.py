@@ -27,12 +27,22 @@ import time
 
 _T0 = time.time()
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_LOG = None
+
 
 def _progress(msg: str) -> None:
-    print(f'[bench +{time.time() - _T0:7.1f}s] {msg}', file=sys.stderr,
-          flush=True)
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    """Structured progress logging via observability.logging (stderr,
+    key/value), replacing the old raw '[bench +Ns]' prints."""
+    global _LOG
+    if _LOG is None:
+        import logging
+        from kyverno_tpu.observability.logging import setup
+        setup()  # text handler on stderr for the 'kyverno' root
+        _LOG = logging.getLogger('kyverno.bench')
+    from kyverno_tpu.observability.logging import with_values
+    with_values(_LOG, msg, elapsed_s=round(time.time() - _T0, 1))
 
 PER_CHIP_TARGET = 50_000 / 4  # north star: 50k/s on v5e-4
 
@@ -861,6 +871,15 @@ def main() -> int:
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
         import jax
         jax.config.update('jax_platforms', 'cpu')
+    # device-pipeline telemetry: per-stage histograms feed the
+    # stage_breakdown block of the JSON line; BENCH_TRACE_JSONL=<path>
+    # additionally streams every stage span as OTLP-shaped JSON lines
+    from kyverno_tpu.observability import device as device_telemetry
+    from kyverno_tpu.observability import tracing as _tracing
+    jsonl_path = os.environ.get('BENCH_TRACE_JSONL', '')
+    if jsonl_path:
+        _tracing.configure(memory=False, jsonl_path=jsonl_path)
+    device_telemetry.configure()
     # BENCH_CONFIG=4|5 runs the scaled BASELINE configs; default is the
     # north-star background scan
     config = os.environ.get('BENCH_CONFIG', '')
@@ -871,6 +890,7 @@ def main() -> int:
             result = run_config5(min(n, 20_000), platform)
         else:
             result = run_bench(n, platform, budget_s)
+        result['stage_breakdown'] = device_telemetry.stage_breakdown()
     except Exception as e:  # noqa: BLE001 - always emit a JSON line
         import traceback
         traceback.print_exc()

@@ -72,6 +72,16 @@ class UpdateRequestController:
 
     def sync_update_request(self, ur: UpdateRequest) -> None:
         """reference: update_request_controller.go syncUpdateRequest"""
+        # background entry point of the trace: any device scans the
+        # processors trigger nest their stage spans under this one
+        from ..observability import tracing
+        with tracing.start_span(
+                'kyverno/background/ur',
+                {'ur': ur.name, 'type': ur.type or '',
+                 'policy': ur.policy_key or ''}) as span:
+            self._sync_update_request(ur, span)
+
+    def _sync_update_request(self, ur: UpdateRequest, span) -> None:
         if ur.type == UR_GENERATE:
             err = self.generate.process_ur(ur)
         elif ur.type == UR_MUTATE:
@@ -81,6 +91,7 @@ class UpdateRequestController:
             ur.set_status(STATE_FAILED, f'unknown request type {ur.type!r}')
             self._store_status(ur)
             return
+        span.set_attribute('result', 'error' if err is not None else 'ok')
         if err is not None:
             key = ur.name
             self._retries[key] = self._retries.get(key, 0) + 1

@@ -53,6 +53,14 @@ class Setup:
             getattr(logging, self.options.log_level.upper()))
         self.metrics = MetricsRegistry() if not self.options.disable_metrics \
             else MetricsRegistry(disabled=['*'])
+        if not self.options.disable_metrics:
+            # publish the daemon registry process-wide and light up the
+            # device-pipeline telemetry (stage histograms, compile-cache
+            # counters, d2h stall watchdog — KTPU_D2H_STALL_S)
+            from ..observability.metrics import set_global_registry
+            from ..observability import device as device_telemetry
+            set_global_registry(self.metrics)
+            device_telemetry.configure(self.metrics)
         self.configuration = Configuration()
         if client is None:
             from ..dclient.client import FakeClient

@@ -349,19 +349,27 @@ class BatchScanner:
             yield 0, z, z, z.astype(np.int32)
             return
         from concurrent.futures import ThreadPoolExecutor
+        from ..observability import device as devtel
+        from ..observability import tracing
         from ..ops.eval import expand_compact, shard_batch
         chunk = self.CHUNK
         small = self.mesh is None and n <= self.SMALL_BATCH
         device = self._small_device() if small else None
+        # pipeline stages run on worker threads where the contextvar
+        # span is absent — capture the request/scan span here so every
+        # stage span joins the caller's trace
+        tel_parent = tracing.current_span()
 
         # multi-chunk scans encode in forked worker processes (off-GIL);
         # small scans stay in-process
         use_procs = n > chunk and self._encoder_pool.start()
 
         def inline_encode(part, part_ctx, bucket):
-            batch = encode_batch(part, self.cps, padded_n=bucket,
-                                 contexts=part_ctx)
-            return batch.tensors()
+            with devtel.stage('encode', {'rows': len(part)},
+                              parent=tel_parent):
+                batch = encode_batch(part, self.cps, padded_n=bucket,
+                                     contexts=part_ctx)
+                return batch.tensors()
 
         def encode(start):
             part = resources[start:start + chunk]
@@ -381,7 +389,18 @@ class BatchScanner:
             return inline_encode(part, part_ctx, bucket), len(part)
 
         def dispatch(enc_future, start):
+            # one wrapper span per chunk: entering it on the dispatch
+            # thread seeds the contextvar so the pack/h2d/compile/
+            # device_eval/d2h child spans (ops/eval.py + below) nest
+            # under it — and under the request trace via tel_parent
+            with tracing.tracer().start_span(
+                    'kyverno/device/chunk', {'chunk_start': start},
+                    parent=tel_parent):
+                return dispatch_work(enc_future, start)
+
+        def dispatch_work(enc_future, start):
             tensors, ln = enc_future.result()
+            devtel.set_batch_size(ln)
             if not isinstance(tensors, dict):
                 # AsyncResult from the fork pool: a dead/OOM-killed worker
                 # never resolves its task, so bound the wait and redo the
@@ -420,9 +439,12 @@ class BatchScanner:
                 # np.array COPIES: np.asarray of a host-backend jax
                 # array is zero-copy, and _free_inputs is about to
                 # release the backing buffers
-                s, d, fd = expand_compact(
-                    np.array(out[0]), np.array(out[1]),
-                    self._evaluator)
+                with devtel.d2h_guard({'chunk_start': start,
+                                       'rows': ln}) as g:
+                    o8 = np.array(out[0])
+                    o32 = np.array(out[1])
+                    g.add_d2h_bytes(o8.nbytes + o32.nbytes)
+                s, d, fd = expand_compact(o8, o32, self._evaluator)
                 self._free_inputs(t, out)
                 return s[:ln], d[:ln], fd[:ln]
             s, d, fd = out
@@ -437,8 +459,11 @@ class BatchScanner:
                     s = multihost_utils.process_allgather(s, tiled=True)
                     d = multihost_utils.process_allgather(d, tiled=True)
                     fd = multihost_utils.process_allgather(fd, tiled=True)
-            s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
-                        np.array(fd)[:ln])
+            with devtel.d2h_guard({'chunk_start': start,
+                                   'rows': ln}) as g:
+                s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
+                            np.array(fd)[:ln])
+                g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
             if self.mesh is None:
                 self._free_inputs(t, out)
             return s, d, fd
@@ -572,10 +597,12 @@ class BatchScanner:
                 except StopIteration:
                     return
                 span.set_attribute('resources', status.shape[0])
-                chunk_rows = self._assemble_chunk(
-                    resources, wrapped, match, start, status, detail,
-                    fdet, now, ts, background_mode, background_ok,
-                    host_maybe)
+                from ..observability import device as devtel
+                with devtel.stage('report', {'rows': status.shape[0]}):
+                    chunk_rows = self._assemble_chunk(
+                        resources, wrapped, match, start, status, detail,
+                        fdet, now, ts, background_mode, background_ok,
+                        host_maybe)
             start += status.shape[0]
             yield from chunk_rows
 
@@ -743,27 +770,30 @@ class BatchScanner:
             fly: Dict[Tuple, Any] = {}
             rows: List[list] = [[] for _ in range(m)]
             row_policies: List[set] = [set() for _ in range(m)]
-            for j, prog in self.device_programs:
-                if not background_ok[j]:
-                    continue
-                rows_j = np.flatnonzero(sub_match[:, j])
-                if rows_j.size == 0:
-                    continue
-                p_idx = prog.policy_index
-                st_col = status[rows_j, j].tolist()
-                det_col = detail[rows_j, j].tolist()
-                for k, st, det in zip(rows_j.tolist(), st_col, det_col):
-                    rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
-                                    resources[start + k])
-                    if rr is _HOST_MARKER:
-                        rr = self._materialize(prog, resources[start + k])
-                        if rr is not None:
-                            rr.timestamp = ts
-                    if rr is None:
+            from ..observability import device as devtel
+            with devtel.stage('report', {'rows': m}):
+                for j, prog in self.device_programs:
+                    if not background_ok[j]:
                         continue
-                    result, sort_key = to_result(rr, p_idx)
-                    rows[k].append((sort_key, result))
-                    row_policies[k].add(p_idx)
+                    rows_j = np.flatnonzero(sub_match[:, j])
+                    if rows_j.size == 0:
+                        continue
+                    p_idx = prog.policy_index
+                    st_col = status[rows_j, j].tolist()
+                    det_col = detail[rows_j, j].tolist()
+                    for k, st, det in zip(rows_j.tolist(), st_col, det_col):
+                        rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
+                                        resources[start + k])
+                        if rr is _HOST_MARKER:
+                            rr = self._materialize(prog,
+                                                   resources[start + k])
+                            if rr is not None:
+                                rr.timestamp = ts
+                        if rr is None:
+                            continue
+                        result, sort_key = to_result(rr, p_idx)
+                        rows[k].append((sort_key, result))
+                        row_policies[k].add(p_idx)
             for k in range(m):
                 i = start + k
                 res_doc = resources[i]

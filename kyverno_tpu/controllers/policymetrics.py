@@ -74,10 +74,12 @@ class PolicyMetricsController:
         labels = self._labels(policy)
         key = f'{policy.namespace}/{policy.name}'
         with self._lock:
-            # retract the previous rule-info series for this policy
+            # retract the previous rule-info series for this policy —
+            # the rule no longer exists, so the series is removed from
+            # exposition entirely (set_gauge(0) would keep it visible)
             for old in self._rules.pop(key, set()):
-                self.registry.set_gauge(POLICY_RULE_INFO, 0.0,
-                                        **dict(old))
+                self.registry.clear_gauge(POLICY_RULE_INFO,
+                                          **dict(old))
             if deleted:
                 return
             current = set()

@@ -1,0 +1,52 @@
+"""Metric catalog: the single source of truth for every metric name
+this process exports.
+
+Each series emitted through :class:`MetricsRegistry` (``inc`` /
+``observe`` / ``set_gauge`` / ``clear_gauge``) must use a name listed
+here with its type and help text; ``scripts/check_metric_names.py``
+(run by ``tests/test_metric_catalog.py``) statically verifies every
+call site against this table, so a typo'd or undocumented metric name
+fails tier-1 instead of silently forking a series.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+
+class Metric(NamedTuple):
+    type: str  # counter | gauge | histogram
+    help: str
+
+
+METRICS: Dict[str, Metric] = {
+    # engine / webhook instruments (reference: pkg/metrics/metrics.go)
+    'kyverno_policy_results_total': Metric(
+        'counter', 'Rule executions by policy/rule/result/resource.'),
+    'kyverno_policy_execution_duration_seconds': Metric(
+        'histogram', 'Per-policy engine execution latency.'),
+    'kyverno_policy_changes_total': Metric(
+        'counter', 'Policy create/update/delete events.'),
+    'kyverno_policy_rule_info_total': Metric(
+        'gauge', '1 per live (policy, rule) pair; retracted on delete.'),
+    'kyverno_admission_review_duration_seconds': Metric(
+        'histogram', 'End-to-end admission handler latency.'),
+    'kyverno_admission_requests_total': Metric(
+        'counter', 'Admission requests by operation/allowed.'),
+    'kyverno_client_queries_total': Metric(
+        'counter', 'Cluster client queries by verb/kind.'),
+    # device-pipeline instruments (observability/device.py)
+    'kyverno_tpu_scan_stage_duration_seconds': Metric(
+        'histogram', 'Batched-scan stage latency; stage=pack|encode|h2d|'
+        'compile|device_eval|d2h|report.'),
+    'kyverno_tpu_compile_cache_requests_total': Metric(
+        'counter', 'Evaluator executable lookups; result=hit|miss|'
+        'aot_load|aot_store.'),
+    'kyverno_tpu_device_batch_size': Metric(
+        'gauge', 'Rows in the most recent device chunk.'),
+    'kyverno_tpu_d2h_bytes_total': Metric(
+        'counter', 'Device-to-host readback bytes.'),
+    'kyverno_tpu_d2h_stalls_total': Metric(
+        'counter', 'Readbacks exceeding the stall watchdog threshold '
+        '(KTPU_D2H_STALL_S, default 30s).'),
+}

@@ -1,0 +1,325 @@
+"""Device-pipeline telemetry: stage spans, TPU metrics, d2h watchdog.
+
+The batched scan path (``compiler/scan.py`` + ``ops/eval.py``) runs as
+a pipeline — pack-plan build, host feature extraction (encode), h2d
+transfer, XLA trace/compile, device eval dispatch, d2h readback, report
+assembly.  This module gives each stage an OTel-shaped child span (via
+``observability.tracing``) and a matching Prometheus series
+(``kyverno_tpu_scan_stage_duration_seconds{stage=...}``), plus cache
+hit/miss counters and a **d2h stall watchdog**: a monitor thread that
+fires a structured event, an ERROR log line, and a
+``kyverno_tpu_d2h_stalls_total`` increment whenever a device→host
+readback blocks longer than ``KTPU_D2H_STALL_S`` (default 30s) — the
+remote-tunnel stalls dominating streaming throughput finally leave a
+trace instead of silently starving the pipeline.
+
+Everything here is a no-op until :func:`configure` runs (and spans
+additionally require ``tracing.configure``): unconfigured processes
+allocate no spans, create no series, and start no threads, so tier-1
+timings and bit-identical PolicyReport output are unaffected.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import tracing
+from .metrics import (WIDE_BUCKETS, MetricsRegistry, global_registry)
+
+SCAN_STAGE_DURATION = 'kyverno_tpu_scan_stage_duration_seconds'
+COMPILE_CACHE_REQUESTS = 'kyverno_tpu_compile_cache_requests_total'
+DEVICE_BATCH_SIZE = 'kyverno_tpu_device_batch_size'
+D2H_BYTES = 'kyverno_tpu_d2h_bytes_total'
+D2H_STALLS = 'kyverno_tpu_d2h_stalls_total'
+
+#: canonical stage labels, in pipeline order
+STAGES = ('pack', 'encode', 'h2d', 'compile', 'device_eval', 'd2h',
+          'report')
+
+_log = logging.getLogger('kyverno.device')
+
+_registry: Optional[MetricsRegistry] = None
+_watchdog: Optional['D2HWatchdog'] = None
+_event_sink: Optional[Callable[[dict], None]] = None
+
+
+def _stall_threshold_default() -> float:
+    try:
+        return float(os.environ.get('KTPU_D2H_STALL_S', '30'))
+    except ValueError:
+        return 30.0
+
+
+def configure(registry: Optional[MetricsRegistry] = None,
+              stall_threshold_s: Optional[float] = None,
+              event_sink: Optional[Callable[[dict], None]] = None
+              ) -> MetricsRegistry:
+    """Enable device-pipeline metrics (and the stall watchdog).
+
+    ``registry`` defaults to the process-global registry, else a fresh
+    one.  Returns the registry in use.  Idempotent; ``disable`` undoes
+    it (and stops the watchdog thread)."""
+    global _registry, _watchdog, _event_sink
+    reg = registry or global_registry() or MetricsRegistry()
+    reg.register_histogram(SCAN_STAGE_DURATION, WIDE_BUCKETS)
+    _event_sink = event_sink
+    threshold = stall_threshold_s if stall_threshold_s is not None \
+        else _stall_threshold_default()
+    if _watchdog is not None:
+        _watchdog.stop()
+    _watchdog = D2HWatchdog(threshold)
+    _registry = reg
+    return reg
+
+
+def disable() -> None:
+    global _registry, _watchdog, _event_sink
+    wd, _watchdog = _watchdog, None
+    _registry = None
+    _event_sink = None
+    if wd is not None:
+        wd.stop()
+
+
+def registry() -> Optional[MetricsRegistry]:
+    return _registry
+
+
+def watchdog() -> Optional['D2HWatchdog']:
+    return _watchdog
+
+
+def enabled() -> bool:
+    """True when any instrumentation would record (metrics configured
+    or tracing on) — the zero-overhead gate for the scan hot path."""
+    return _registry is not None or tracing.tracer().enabled
+
+
+# -- stage timers -----------------------------------------------------------
+
+class _NoopStage:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attribute(self, key, value):
+        pass
+
+    def add_d2h_bytes(self, n):
+        pass
+
+
+_NOOP_STAGE = _NoopStage()
+
+
+class _Stage:
+    __slots__ = ('stage', 'span', '_t0')
+
+    def __init__(self, stage: str, span, t0: float):
+        self.stage = stage
+        self.span = span
+        self._t0 = t0
+
+    def set_attribute(self, key, value):
+        self.span.set_attribute(key, value)
+
+    def add_d2h_bytes(self, n: int) -> None:
+        add_d2h_bytes(n)
+
+    def __enter__(self):
+        self.span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.span.__exit__(exc_type, exc, tb)
+        if _registry is not None:
+            _registry.observe(SCAN_STAGE_DURATION,
+                              time.monotonic() - self._t0,
+                              stage=self.stage)
+        return False
+
+
+def stage(name: str, attributes: Optional[Dict[str, Any]] = None,
+          parent=None):
+    """Context manager timing one pipeline stage: a
+    ``kyverno/device/<name>`` span (child of ``parent`` or the context
+    span) plus a stage-labelled histogram sample.  Returns a shared
+    no-op when telemetry is unconfigured."""
+    if _registry is None and not tracing.tracer().enabled:
+        return _NOOP_STAGE
+    span = tracing.tracer().start_span(f'kyverno/device/{name}',
+                                       attributes, parent=parent)
+    return _Stage(name, span, time.monotonic())
+
+
+# -- counters / gauges ------------------------------------------------------
+
+def record_cache(result: str) -> None:
+    """Executable-cache outcome: hit | miss | aot_load | aot_store."""
+    if _registry is not None:
+        _registry.inc(COMPILE_CACHE_REQUESTS, result=result)
+
+
+def set_batch_size(n: int) -> None:
+    if _registry is not None:
+        _registry.set_gauge(DEVICE_BATCH_SIZE, float(n))
+
+
+def add_d2h_bytes(n: int) -> None:
+    if _registry is not None and n:
+        _registry.inc(D2H_BYTES, float(n))
+
+
+# -- d2h stall watchdog -----------------------------------------------------
+
+class D2HWatchdog:
+    """Monitor thread flagging device→host readbacks that exceed a
+    threshold.  ``arm`` registers a readback; if it is still armed past
+    its deadline the watchdog fires ONCE for it: structured event +
+    ERROR log line + ``kyverno_tpu_d2h_stalls_total`` increment.  The
+    thread starts lazily on the first ``arm`` and exits on ``stop`` —
+    an unconfigured or idle process runs no thread."""
+
+    def __init__(self, threshold_s: float):
+        self.threshold_s = threshold_s
+        self._cv = threading.Condition()
+        self._entries: Dict[int, list] = {}  # token -> [start, attrs, fired]
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self.stall_events: 'collections.deque[dict]' = \
+            collections.deque(maxlen=256)
+
+    def arm(self, attrs: Optional[Dict[str, Any]] = None) -> int:
+        with self._cv:
+            if self._stopped:
+                return -1
+            token = self._seq
+            self._seq += 1
+            self._entries[token] = [time.monotonic(), dict(attrs or {}),
+                                    False]
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name='ktpu-d2h-watchdog',
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        return token
+
+    def disarm(self, token: int) -> float:
+        with self._cv:
+            entry = self._entries.pop(token, None)
+        if entry is None:
+            return 0.0
+        return time.monotonic() - entry[0]
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._entries.clear()
+            self._cv.notify()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        with self._cv:
+            while not self._stopped:
+                now = time.monotonic()
+                next_deadline: Optional[float] = None
+                for entry in self._entries.values():
+                    start, attrs, fired = entry
+                    if fired:
+                        continue
+                    deadline = start + self.threshold_s
+                    if deadline <= now:
+                        entry[2] = True
+                        self._fire(now - start, attrs)
+                    elif next_deadline is None or deadline < next_deadline:
+                        next_deadline = deadline
+                timeout = None if next_deadline is None \
+                    else max(next_deadline - now, 0.01)
+                self._cv.wait(timeout)
+
+    def _fire(self, elapsed_s: float, attrs: Dict[str, Any]) -> None:
+        event = {
+            'type': 'd2h_stall',
+            'threshold_s': self.threshold_s,
+            'elapsed_s': round(elapsed_s, 3),
+            'ts': time.time(),
+            **attrs,
+        }
+        self.stall_events.append(event)
+        if _registry is not None:
+            _registry.inc(D2H_STALLS)
+        from .logging import with_values
+        with_values(_log, 'd2h readback stalled', level=logging.ERROR,
+                    **{k: v for k, v in event.items() if k != 'type'})
+        sink = _event_sink
+        if sink is not None:
+            try:
+                sink(event)
+            except Exception:  # noqa: BLE001 - sinks must not break d2h
+                pass
+
+
+class _D2HGuard:
+    """Stage timer for a readback with the watchdog armed around it."""
+
+    __slots__ = ('_stage', '_token')
+
+    def __init__(self, stage_cm, token: int):
+        self._stage = stage_cm
+        self._token = token
+
+    def set_attribute(self, key, value):
+        self._stage.set_attribute(key, value)
+
+    def add_d2h_bytes(self, n: int) -> None:
+        add_d2h_bytes(n)
+
+    def __enter__(self):
+        self._stage.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wd = _watchdog
+        if wd is not None and self._token >= 0:
+            wd.disarm(self._token)
+        return self._stage.__exit__(exc_type, exc, tb)
+
+
+def d2h_guard(attributes: Optional[Dict[str, Any]] = None, parent=None):
+    """``stage('d2h')`` with the stall watchdog armed for its duration."""
+    if _registry is None and not tracing.tracer().enabled:
+        return _NOOP_STAGE
+    token = _watchdog.arm(attributes) if _watchdog is not None else -1
+    return _D2HGuard(stage('d2h', attributes, parent=parent), token)
+
+
+def stage_breakdown() -> Dict[str, Dict[str, float]]:
+    """Per-stage {total_s, count, mean_s} from the stage histogram —
+    the ``stage_breakdown`` block bench.py embeds in its JSON line."""
+    if _registry is None:
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for key, count, total in _registry.histogram_series(
+            SCAN_STAGE_DURATION):
+        labels = dict(key)
+        stage_name = labels.get('stage', '')
+        out[stage_name] = {
+            'total_s': round(total, 4),
+            'count': count,
+            'mean_s': round(total / count, 6) if count else 0.0,
+        }
+    return out

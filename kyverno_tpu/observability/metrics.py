@@ -18,6 +18,12 @@ from typing import Dict, List, Optional, Tuple
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                     2.5, 5.0, 10.0)
 
+#: compile/scan-scale buckets: fresh-cache policy-set compiles measure
+#: 43-49s (STATUS.md) — the default buckets top out at 10s and every
+#: compile sample would land in +Inf
+WIDE_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+                30.0, 60.0, 120.0)
+
 
 class MetricsRegistry:
     def __init__(self, disabled: Optional[List[str]] = None):
@@ -25,7 +31,17 @@ class MetricsRegistry:
         self._counters: Dict[str, Dict[Tuple, float]] = {}
         self._gauges: Dict[str, Dict[Tuple, float]] = {}
         self._hists: Dict[str, Dict[Tuple, List]] = {}
+        self._buckets: Dict[str, Tuple[float, ...]] = {}
         self._disabled = set(disabled or [])
+
+    def register_histogram(self, name: str,
+                           buckets: Tuple[float, ...]) -> None:
+        """Per-histogram bucket override; must run before the first
+        ``observe`` of ``name`` (bucket counters are sized on first
+        sample)."""
+        with self._lock:
+            if name not in self._hists:
+                self._buckets[name] = tuple(buckets)
 
     def configure(self, disabled: List[str]) -> None:
         with self._lock:
@@ -40,15 +56,23 @@ class MetricsRegistry:
             series[key] = series.get(key, 0.0) + amount
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
+        # zero is a legitimate gauge value (a scraped series vanishing
+        # reads as "target gone", not "value is 0") — intentional
+        # removal goes through clear_gauge
         if name in self._disabled:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
-            series = self._gauges.setdefault(name, {})
-            if value == 0.0:
+            self._gauges.setdefault(name, {})[key] = value
+
+    def clear_gauge(self, name: str, **labels) -> None:
+        """Drop one gauge series from exposition (retraction of a
+        no-longer-existing label combination, e.g. a deleted rule)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            series = self._gauges.get(name)
+            if series is not None:
                 series.pop(key, None)
-            else:
-                series[key] = value
 
     def gauge_value(self, name: str, **labels) -> float:
         key = tuple(sorted(labels.items()))
@@ -64,16 +88,35 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
+            bounds = self._buckets.get(name, _DEFAULT_BUCKETS)
             series = self._hists.setdefault(name, {})
             entry = series.get(key)
             if entry is None:
-                entry = [0, 0.0, [0] * len(_DEFAULT_BUCKETS)]
+                entry = [0, 0.0, [0] * len(bounds)]
                 series[key] = entry
             entry[0] += 1
             entry[1] += value
-            for i, bound in enumerate(_DEFAULT_BUCKETS):
+            for i, bound in enumerate(bounds):
                 if value <= bound:
                     entry[2][i] += 1
+
+    def histogram_sum(self, name: str, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            entry = self._hists.get(name, {}).get(key)
+            return entry[1] if entry is not None else 0.0
+
+    def histogram_count(self, name: str, **labels) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            entry = self._hists.get(name, {}).get(key)
+            return entry[0] if entry is not None else 0
+
+    def histogram_series(self, name: str) -> List[Tuple[Tuple, int, float]]:
+        """(label key, count, sum) per series — stage-breakdown reads."""
+        with self._lock:
+            return [(key, entry[0], entry[1])
+                    for key, entry in self._hists.get(name, {}).items()]
 
     # -- reads -----------------------------------------------------------
 
@@ -100,10 +143,11 @@ class MetricsRegistry:
                     out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
             for name in sorted(self._hists):
                 out.append(f'# TYPE {name} histogram')
+                bounds = self._buckets.get(name, _DEFAULT_BUCKETS)
                 for key, (count, total, buckets) in sorted(
                         self._hists[name].items()):
                     # observe() already stores cumulative bucket counts
-                    for bound, b in zip(_DEFAULT_BUCKETS, buckets):
+                    for bound, b in zip(bounds, buckets):
                         lk = key + (('le', _fmt(bound)),)
                         out.append(
                             f'{name}_bucket{_fmt_labels(lk)} {b}')
@@ -124,6 +168,24 @@ def _fmt_labels(key: Tuple) -> str:
         return ''
     parts = ','.join(f'{k}="{v}"' for k, v in key)
     return '{' + parts + '}'
+
+
+# -- process-global registry ------------------------------------------------
+# The daemons create one registry in cmd/internal.Setup; subsystems that
+# cannot take a registry parameter (device pipeline, webhook timing)
+# publish through this hook.  None until configured: every emit site
+# checks and no-ops, so an unconfigured process pays one attribute read.
+
+_GLOBAL: Optional[MetricsRegistry] = None
+
+
+def set_global_registry(registry: Optional[MetricsRegistry]) -> None:
+    global _GLOBAL
+    _GLOBAL = registry
+
+
+def global_registry() -> Optional[MetricsRegistry]:
+    return _GLOBAL
 
 
 # instrument names (reference: pkg/metrics/metrics.go:91-224)

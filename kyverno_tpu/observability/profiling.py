@@ -107,6 +107,12 @@ class ProfilingServer:
                         if mem is not None else []
                     self._send(json.dumps({'spans': spans}),
                                'application/json')
+                elif parsed.path == '/metrics':
+                    from . import device
+                    from .metrics import global_registry
+                    reg = device.registry() or global_registry()
+                    self._send(reg.render() if reg is not None else '',
+                               'text/plain; version=0.0.4')
                 else:
                     self._send('not found', code=404)
 

@@ -140,6 +140,38 @@ class InMemoryExporter:
             self._spans.clear()
 
 
+class JsonlExporter:
+    """Append each finished span as one OTLP-shaped JSON line.
+
+    Serves the bench path: a scan run leaves a machine-readable
+    per-stage record on disk (``stage_breakdown`` assembly) without a
+    collector.  Writes are line-buffered and locked; a write failure
+    disables the exporter rather than breaking the span path."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = open(path, 'a', buffering=1)
+
+    def __call__(self, span: Span) -> None:
+        with self._lock:
+            if self._file is None:
+                return
+            try:
+                import json
+                self._file.write(json.dumps(span.to_otlp()) + '\n')
+            except (OSError, ValueError):
+                self.close()
+
+    def close(self) -> None:
+        f, self._file = self._file, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
 class Tracer:
     """reference: pkg/tracing — StartSpan/ChildSpan equivalents."""
 
@@ -149,11 +181,16 @@ class Tracer:
         self.enabled = enabled
 
     def start_span(self, name: str,
-                   attributes: Optional[Dict[str, Any]] = None):
-        """Child of the context's current span (childspan.go ChildSpan1)."""
+                   attributes: Optional[Dict[str, Any]] = None,
+                   parent: Optional[Span] = None):
+        """Child of the context's current span (childspan.go ChildSpan1).
+        ``parent`` overrides the contextvar — pipeline stages running on
+        worker threads pass the request span captured at scan entry so
+        one trace covers request → device → report."""
         if not self.enabled:
             return _NOOP_SPAN
-        return Span(self, name, _current_span.get(), attributes)
+        return Span(self, name, parent if parent is not None
+                    else _current_span.get(), attributes)
 
     def _export(self, span: Span) -> None:
         for exporter in self.exporters:
@@ -169,7 +206,8 @@ _memory: Optional[InMemoryExporter] = None
 
 
 def configure(otlp_exporter: Optional[Callable[[Span], None]] = None,
-              memory: bool = True) -> Optional[InMemoryExporter]:
+              memory: bool = True,
+              jsonl_path: Optional[str] = None) -> Optional[InMemoryExporter]:
     """Enable tracing (flag parity: cmd/internal/flag.go:46-49
     enableTracing/tracingAddress). Returns the in-memory exporter."""
     global _tracer, _memory
@@ -179,12 +217,18 @@ def configure(otlp_exporter: Optional[Callable[[Span], None]] = None,
         exporters.append(_memory)
     if otlp_exporter is not None:
         exporters.append(otlp_exporter)
+    if jsonl_path is not None:
+        exporters.append(JsonlExporter(jsonl_path))
     _tracer = Tracer(exporters)
     return _memory
 
 
 def disable() -> None:
     global _tracer, _memory
+    for exporter in _tracer.exporters:
+        close = getattr(exporter, 'close', None)
+        if close is not None:
+            close()
     _tracer = _NOOP_TRACER
     _memory = None
 

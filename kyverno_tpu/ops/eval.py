@@ -1945,6 +1945,11 @@ def build_evaluator(cps: CompiledPolicySet):
     jitted = jax.jit(evaluate_packed)
     fingerprint = policy_set_fingerprint(cps.policies)
     exec_cache: Dict[str, Any] = {}
+    # input signatures the jitted fallback has already traced — mirrors
+    # jax.jit's own cache key well enough for hit/miss telemetry on the
+    # paths where the AOT executable cache is unavailable (mesh, >1
+    # local device)
+    jit_seen: set = set()
     # one lock covers exec_cache AND every trace of evaluate_packed:
     # the trace reads layout_holder, so an unsynchronized concurrent
     # call could bake another batch shape's layout into the executable
@@ -1955,18 +1960,27 @@ def build_evaluator(cps: CompiledPolicySet):
         """Executable for this input signature: memory → AOT disk →
         trace+compile (and populate both).  None → mesh-sharded inputs
         or AOT disabled; caller falls back to the jitted path."""
+        from ..observability import device as devtel
         key = _aot_key(fingerprint, packed)
         if key is None:
             return None
         with compile_lock:
             hit = exec_cache.get(key)
             if hit is not None:
+                devtel.record_cache('hit')
                 return hit
-            loaded = _aot_load(key)
-            if loaded is None:
-                layout_holder['layout'] = layout
-                loaded = jitted.lower(packed).compile()
-                _aot_store_async(key, loaded)
+            with devtel.stage('compile') as st:
+                loaded = _aot_load(key)
+                if loaded is not None:
+                    devtel.record_cache('aot_load')
+                    st.set_attribute('cache', 'aot_load')
+                else:
+                    layout_holder['layout'] = layout
+                    loaded = jitted.lower(packed).compile()
+                    devtel.record_cache('miss')
+                    st.set_attribute('cache', 'miss')
+                    _aot_store_async(key, loaded)
+                    devtel.record_cache('aot_store')
             exec_cache[key] = loaded
             return loaded
 
@@ -1990,6 +2004,7 @@ def build_evaluator(cps: CompiledPolicySet):
         # i64 lanes are required: quantity milli-values span past 2^31.
         # Scope x64 to this call instead of flipping the process-global
         # flag at import time.
+        from ..observability import device as devtel
         with enable_x64():
             try:
                 compiled = _compiled_for(packed, layout)
@@ -1997,7 +2012,8 @@ def build_evaluator(cps: CompiledPolicySet):
                 compiled = None
             if compiled is not None:
                 try:
-                    return compiled(packed)
+                    with devtel.stage('device_eval'):
+                        return compiled(packed)
                 except Exception:  # noqa: BLE001 - a deserialized
                     # executable can fail at EXECUTE time (e.g. machine-
                     # feature mismatch); evict it and fall through to a
@@ -2006,7 +2022,23 @@ def build_evaluator(cps: CompiledPolicySet):
                     _evict_aot(packed)
             with compile_lock:
                 layout_holder['layout'] = layout
-                return jitted(packed)
+                if devtel.enabled():
+                    sig = tuple(
+                        (k, str(v.dtype), tuple(v.shape))
+                        for k, v in sorted(packed.items()))
+                    if sig not in jit_seen:
+                        # first call at this signature pays jit trace +
+                        # XLA compile inside the dispatch — time it as
+                        # the compile stage (jit caches internally, so
+                        # a separate lower().compile() would double-pay)
+                        jit_seen.add(sig)
+                        devtel.record_cache('miss')
+                        with devtel.stage('compile') as st:
+                            st.set_attribute('cache', 'miss')
+                            return jitted(packed)
+                    devtel.record_cache('hit')
+                with devtel.stage('device_eval'):
+                    return jitted(packed)
 
     call.jitted = jitted
     call.raw = evaluate
@@ -2060,7 +2092,10 @@ def expand_compact(out8: np.ndarray, out32: np.ndarray, evaluator):
 
 
 def enable_x64():
-    return jax.enable_x64()
+    # jax 0.4.37 dropped the (never-public) jax.enable_x64 alias; the
+    # supported spelling is jax.experimental.enable_x64
+    from jax.experimental import enable_x64 as _enable_x64
+    return _enable_x64()
 
 
 #: pack plans memoized by lane signature — admission serves thousands of
@@ -2134,8 +2169,11 @@ def shard_batch(tensors: Dict[str, np.ndarray], mesh=None,
     transferred inside an x64 scope so they are not downcast.  Returns
     (packed_device_dict, layout)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    packed, layout = pack_batch(tensors)
-    with enable_x64():
+    from ..observability import device as devtel
+    with devtel.stage('pack'):
+        packed, layout = pack_batch(tensors)
+    with enable_x64(), devtel.stage('h2d') as st:
+        st.set_attribute('bytes', sum(v.nbytes for v in packed.values()))
         if mesh is None:
             if device is not None:
                 return ({k: jax.device_put(v, device)

@@ -495,6 +495,12 @@ class ResourceHandlers:
                 ctx = pctx.copy()
                 ctx.policy = policy
                 responses.append(self.engine.validate(ctx))
+        # annotate the handler span with the serving path so a trace
+        # distinguishes compiled-device requests from host-loop ones
+        from ..observability import tracing
+        span = tracing.current_span()
+        if span is not None:
+            span.set_attribute('device_path', bool(use_device))
         blocked = block_request(responses, failure_policy)
         if self.event_sink is not None and responses:
             # reference: handlers.go Validate -> webhooks/utils/event.go

@@ -131,7 +131,12 @@ class WebhookServer:
             raise KeyError(path)
         review = json.loads(body)
         request = admission.parse_review(review)
+        import time as _time
         from ..observability import tracing
+        from ..observability.metrics import (ADMISSION_REQUESTS,
+                                             ADMISSION_REVIEW_DURATION,
+                                             global_registry)
+        t0 = _time.monotonic()
         with tracing.start_span(
                 f'webhooks{path}',
                 {'uid': request.get('uid', ''),
@@ -139,6 +144,15 @@ class WebhookServer:
                  'operation': request.get('operation', '')}) as span:
             resp = handler(request)
             span.set_attribute('allowed', resp.get('allowed'))
+        registry = global_registry()
+        if registry is not None:
+            operation = request.get('operation', '') or ''
+            allowed = str(bool(resp.get('allowed'))).lower()
+            registry.observe(ADMISSION_REVIEW_DURATION,
+                             _time.monotonic() - t0,
+                             operation=operation, allowed=allowed)
+            registry.inc(ADMISSION_REQUESTS, operation=operation,
+                         allowed=allowed)
         return json.dumps(
             admission.review_response(request, resp)).encode('utf-8')
 

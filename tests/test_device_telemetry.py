@@ -1,0 +1,222 @@
+"""Device-pipeline telemetry: stage spans over the batched scan path,
+compile-cache counters, the d2h stall watchdog, and the zero-overhead
+no-op guarantees when tracing/metrics are unconfigured."""
+
+import threading
+import time
+
+import pytest
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.observability import device as devtel
+from kyverno_tpu.observability import tracing
+from kyverno_tpu.observability.metrics import MetricsRegistry
+
+POLICY = {
+    'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+    'metadata': {'name': 'require-labels', 'annotations': {
+        'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+    'spec': {'validationFailureAction': 'Enforce', 'rules': [
+        {'name': 'check-app',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'validate': {'message': 'app label required',
+                      'pattern': {'metadata': {'labels': {'app': '?*'}}}}},
+    ]}}
+
+
+def pod(i):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': f'p{i}', 'namespace': 'default',
+                         'labels': {'app': 'x'} if i % 2 else {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx:1'}]}}
+
+
+@pytest.fixture
+def telemetry():
+    mem = tracing.configure()
+    reg = devtel.configure(MetricsRegistry())
+    yield mem, reg
+    devtel.disable()
+    tracing.disable()
+
+
+@pytest.fixture
+def scanner():
+    from kyverno_tpu.compiler.scan import BatchScanner
+    return BatchScanner([Policy(POLICY)])
+
+
+def _watchdog_threads():
+    return [t for t in threading.enumerate()
+            if t.name == 'ktpu-d2h-watchdog']
+
+
+class TestStageSpans:
+    def test_scan_emits_all_stages(self, telemetry, scanner):
+        mem, reg = telemetry
+        # first scan pays the compile stage; the second hits the cached
+        # executable and runs as device_eval
+        scanner.scan([pod(i) for i in range(8)])
+        scanner.scan([pod(i) for i in range(8)])
+        names = {s.name for s in mem.spans()}
+        assert 'kyverno/device/compile' in names
+        assert reg.histogram_count(
+            'kyverno_tpu_scan_stage_duration_seconds',
+            stage='compile') >= 1
+        for stage in ('encode', 'pack', 'h2d', 'device_eval', 'd2h',
+                      'report'):
+            assert f'kyverno/device/{stage}' in names, stage
+            assert reg.histogram_count(
+                'kyverno_tpu_scan_stage_duration_seconds',
+                stage=stage) >= 1, stage
+
+    def test_stage_spans_join_one_trace(self, telemetry, scanner):
+        """request root → chunk wrapper → device stage spans all carry
+        one trace id (the single-trace requirement of the pipeline)."""
+        mem, _reg = telemetry
+        scanner.scan([pod(i) for i in range(4)])  # warm the executable
+        with tracing.start_span('request-root') as root:
+            scanner.scan([pod(i) for i in range(4)])
+        by_name = {}
+        for s in mem.spans():
+            by_name.setdefault(s.name, []).append(s)
+        [chunk] = [s for s in by_name['kyverno/device/chunk']
+                   if s.trace_id == root.trace_id]
+        # the chunk wrapper nests under the per-chunk scan span, which
+        # nests under the request root
+        parents = {s.span_id: s for s in mem.spans()}
+        scan_span = parents[chunk.parent_id]
+        assert scan_span.name == 'kyverno/device/scan'
+        assert scan_span.parent_id == root.span_id
+        for stage in ('pack', 'h2d', 'device_eval', 'd2h'):
+            stage_spans = [s for s in by_name[f'kyverno/device/{stage}']
+                           if s.trace_id == root.trace_id]
+            assert stage_spans, stage
+            assert all(s.parent_id == chunk.span_id
+                       for s in stage_spans), stage
+
+    def test_compile_cache_counters(self, telemetry):
+        _mem, reg = telemetry
+        from kyverno_tpu.compiler.scan import BatchScanner
+        fresh = BatchScanner([Policy(POLICY)])
+        fresh.scan([pod(i) for i in range(4)])   # compiles or aot-loads
+        fresh.scan([pod(i) for i in range(4)])   # memory hit
+        total = reg.counter_total(
+            'kyverno_tpu_compile_cache_requests_total')
+        hits = reg.counter_value(
+            'kyverno_tpu_compile_cache_requests_total', result='hit')
+        assert total >= 2
+        assert hits >= 1
+        text = reg.render()
+        assert 'kyverno_tpu_compile_cache_requests_total' in text
+        assert 'result="hit"' in text
+
+    def test_batch_size_and_d2h_bytes(self, telemetry, scanner):
+        _mem, reg = telemetry
+        scanner.scan([pod(i) for i in range(8)])
+        assert reg.gauge_value('kyverno_tpu_device_batch_size') == 8.0
+        assert reg.counter_total('kyverno_tpu_d2h_bytes_total') > 0
+
+
+class TestWatchdog:
+    def test_fires_on_delayed_d2h(self):
+        fired = []
+        tracing.disable()
+        reg = devtel.configure(MetricsRegistry(), stall_threshold_s=0.05,
+                               event_sink=fired.append)
+        try:
+            with devtel.d2h_guard({'chunk_start': 0}):
+                time.sleep(0.25)  # artificially delayed readback
+            deadline = time.time() + 2.0
+            while not fired and time.time() < deadline:
+                time.sleep(0.01)
+            assert reg.counter_total('kyverno_tpu_d2h_stalls_total') == 1
+            [event] = fired
+            assert event['type'] == 'd2h_stall'
+            assert event['elapsed_s'] >= 0.05
+            assert event['chunk_start'] == 0
+            assert devtel.watchdog().stall_events
+        finally:
+            devtel.disable()
+
+    def test_silent_under_threshold(self):
+        fired = []
+        reg = devtel.configure(MetricsRegistry(), stall_threshold_s=0.5,
+                               event_sink=fired.append)
+        try:
+            for _ in range(3):
+                with devtel.d2h_guard():
+                    time.sleep(0.01)
+            time.sleep(0.2)  # give the monitor a chance to misfire
+            assert reg.counter_total('kyverno_tpu_d2h_stalls_total') == 0
+            assert not fired
+        finally:
+            devtel.disable()
+
+    def test_fires_once_per_stall(self):
+        reg = devtel.configure(MetricsRegistry(), stall_threshold_s=0.03)
+        try:
+            with devtel.d2h_guard():
+                time.sleep(0.2)
+            time.sleep(0.1)
+            assert reg.counter_total('kyverno_tpu_d2h_stalls_total') == 1
+        finally:
+            devtel.disable()
+
+    def test_env_default_threshold(self, monkeypatch):
+        monkeypatch.setenv('KTPU_D2H_STALL_S', '7.5')
+        devtel.configure(MetricsRegistry())
+        try:
+            assert devtel.watchdog().threshold_s == 7.5
+        finally:
+            devtel.disable()
+
+    def test_thread_stops_on_disable(self):
+        devtel.configure(MetricsRegistry(), stall_threshold_s=10.0)
+        token = devtel.watchdog().arm()
+        assert _watchdog_threads()
+        devtel.watchdog().disarm(token)
+        devtel.disable()
+        deadline = time.time() + 2.0
+        while _watchdog_threads() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not _watchdog_threads()
+
+
+class TestNoopWhenUnconfigured:
+    def test_scan_allocates_nothing(self, scanner):
+        tracing.disable()
+        devtel.disable()
+        before = set(threading.enumerate())
+        scanner.scan([pod(i) for i in range(8)])
+        assert tracing.memory_exporter() is None
+        assert devtel.registry() is None
+        assert devtel.watchdog() is None
+        assert not _watchdog_threads()
+        # only the scan pipeline's own executor threads may appear —
+        # no telemetry thread survives the call
+        after = {t for t in threading.enumerate() if t not in before}
+        assert not any(t.name == 'ktpu-d2h-watchdog' for t in after)
+        assert devtel.stage_breakdown() == {}
+
+    def test_stage_returns_shared_noop(self):
+        tracing.disable()
+        devtel.disable()
+        s1 = devtel.stage('pack')
+        s2 = devtel.stage('d2h')
+        g = devtel.d2h_guard()
+        assert s1 is s2 is g  # one shared no-op object, no allocation
+        with s1:
+            s1.set_attribute('k', 'v')
+            s1.add_d2h_bytes(10)
+
+    def test_tracing_only_emits_spans_not_series(self, scanner):
+        devtel.disable()
+        mem = tracing.configure()
+        try:
+            scanner.scan([pod(i) for i in range(4)])
+            assert any(s.name.startswith('kyverno/device/')
+                       for s in mem.spans())
+            assert devtel.registry() is None
+        finally:
+            tracing.disable()

@@ -1,0 +1,44 @@
+"""Metric-name drift gate: every registry write site must use a name
+cataloged in observability/catalog.py (scripts/check_metric_names.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    'scripts'))
+
+import check_metric_names  # noqa: E402
+
+
+def test_all_metric_names_cataloged(capsys):
+    rc = check_metric_names.main()
+    assert rc == 0, capsys.readouterr().err
+
+
+def test_catalog_entries_well_formed():
+    from kyverno_tpu.observability.catalog import METRICS
+    assert METRICS, 'catalog must not be empty'
+    for name, metric in METRICS.items():
+        assert name.startswith('kyverno'), name
+        assert metric.type in ('counter', 'gauge', 'histogram'), name
+        assert metric.help.strip(), name
+        # prometheus conventions: counters end in _total
+        if metric.type == 'counter':
+            assert name.endswith('_total'), name
+
+
+def test_checker_catches_unknown_name(tmp_path, monkeypatch):
+    """A call site using an uncataloged literal must fail the check."""
+    rogue = os.path.join(check_metric_names.PACKAGE, '_rogue_metric.py')
+    with open(rogue, 'w') as f:
+        f.write("def emit(reg):\n"
+                "    reg.inc('kyverno_tpu_not_in_catalog_total')\n")
+    try:
+        resolved, _unresolved = check_metric_names.collect_call_sites()
+        names = {n for _p, _l, n in resolved}
+        assert 'kyverno_tpu_not_in_catalog_total' in names
+        catalog = check_metric_names.load_catalog()
+        assert 'kyverno_tpu_not_in_catalog_total' not in catalog
+    finally:
+        os.unlink(rogue)

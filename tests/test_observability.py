@@ -62,6 +62,51 @@ class TestMetrics:
         record_policy_results(reg, run_engine({}), 'CREATE')
         assert reg.counter_total(POLICY_RESULTS) == 0
 
+    def test_zero_gauge_stays_visible(self):
+        """set_gauge(0) must keep the series in exposition — a vanished
+        series reads as 'target gone', not 'value is zero'."""
+        reg = MetricsRegistry()
+        reg.set_gauge('kyverno_policy_rule_info_total', 1.0, rule='r')
+        reg.set_gauge('kyverno_policy_rule_info_total', 0.0, rule='r')
+        text = reg.render()
+        assert 'kyverno_policy_rule_info_total{rule="r"} 0' in text
+        assert reg.gauge_value('kyverno_policy_rule_info_total',
+                               rule='r') == 0.0
+
+    def test_clear_gauge_removes_series(self):
+        reg = MetricsRegistry()
+        reg.set_gauge('kyverno_policy_rule_info_total', 1.0, rule='r')
+        reg.clear_gauge('kyverno_policy_rule_info_total', rule='r')
+        assert 'rule="r"' not in reg.render()
+        # clearing an unknown series is a no-op
+        reg.clear_gauge('kyverno_policy_rule_info_total', rule='ghost')
+
+    def test_histogram_bucket_override(self):
+        """Compile-scale samples (43-49s fresh-cache compiles) must land
+        in real buckets, not +Inf — per-histogram overrides up to 120s."""
+        from kyverno_tpu.observability.metrics import WIDE_BUCKETS
+        reg = MetricsRegistry()
+        name = 'kyverno_tpu_scan_stage_duration_seconds'
+        reg.register_histogram(name, WIDE_BUCKETS)
+        reg.observe(name, 45.0, stage='compile')
+        text = reg.render()
+        assert 'le="60"' in text and 'le="120"' in text
+        # the 45s sample is inside the 60s and 120s buckets
+        assert f'{name}_bucket{{stage="compile",le="60"}} 1' in text
+        assert f'{name}_bucket{{stage="compile",le="120"}} 1' in text
+        assert f'{name}_bucket{{stage="compile",le="30"}} 0' in text
+        assert WIDE_BUCKETS[-1] >= 120.0
+
+    def test_bucket_override_ignored_after_first_sample(self):
+        reg = MetricsRegistry()
+        reg.observe('kyverno_admission_review_duration_seconds', 0.2)
+        # too late: series already sized on the default buckets
+        reg.register_histogram(
+            'kyverno_admission_review_duration_seconds', (1.0, 2.0))
+        reg.observe('kyverno_admission_review_duration_seconds', 0.3)
+        assert reg.histogram_count(
+            'kyverno_admission_review_duration_seconds') == 2
+
 
 class TestEvents:
     def test_violation_events_created(self):
