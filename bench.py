@@ -891,6 +891,18 @@ def main() -> int:
         else:
             result = run_bench(n, platform, budget_s)
         result['stage_breakdown'] = device_telemetry.stage_breakdown()
+        # executable-cache outcomes + persisted AOT store state: warm_s
+        # regressions are diagnosable from the JSON line alone (was the
+        # store cold, disabled, or bypassed?)
+        reg = device_telemetry.registry()
+        if reg is not None:
+            from kyverno_tpu.aotcache import default_store
+            counter = 'kyverno_tpu_compile_cache_requests_total'
+            result['compile_cache'] = {
+                r: int(reg.counter_value(counter, result=r))
+                for r in ('hit', 'miss', 'aot_load', 'aot_store')}
+            result['aot_store'] = dict(default_store().stats(),
+                                       enabled=default_store().enabled)
     except Exception as e:  # noqa: BLE001 - always emit a JSON line
         import traceback
         traceback.print_exc()

@@ -1,0 +1,175 @@
+"""Cache-key derivation for persisted executables + the XLA
+persistent-compilation-cache hookup.
+
+An AOT entry is only loadable in a process that matches the one that
+compiled it, so the key covers every axis that changes the generated
+code: the policy-set fingerprint, the evaluator/compiler source digest,
+jax + jaxlib versions, the backend platform and device identity
+(kind/topology), the host CPU feature set, the ambient XLA environment
+(flags, platform selection, which PJRT plugins initialized), and the
+batch input signature (name/dtype/shape per lane — the batch layout).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+#: bump to invalidate every persisted executable (framing/codec changes)
+AOT_VERSION = 2
+
+_SOURCE_DIGEST: Optional[str] = None
+
+
+def source_digest() -> str:
+    """Digest of the compiler/evaluator sources: any code change
+    invalidates AOT entries (the executable bakes in their semantics)."""
+    global _SOURCE_DIGEST
+    if _SOURCE_DIGEST is None:
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for rel in ('ops/eval.py', 'compiler/compile.py',
+                    'compiler/encode.py', 'compiler/ir.py',
+                    'compiler/pss_compile.py'):
+            try:
+                with open(os.path.join(base, rel), 'rb') as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(rel.encode())
+        _SOURCE_DIGEST = h.hexdigest()[:16]
+    return _SOURCE_DIGEST
+
+
+def policy_set_fingerprint(policies) -> str:
+    """Stable digest of a policy set's raw documents (the evaluator HLO
+    is a deterministic function of them — verified cross-process)."""
+    import json
+    payload = json.dumps([getattr(p, 'raw', p) for p in policies],
+                         sort_keys=True, separators=(',', ':'),
+                         default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:20]
+
+
+def host_fingerprint() -> str:
+    """Short hash of the host CPU feature set.  XLA:CPU AOT artifacts
+    embed the compile machine's features and can SIGILL when loaded on a
+    host missing them; scoping the cache dir per feature set keeps a
+    shared checkout safe across heterogeneous machines."""
+    try:
+        with open('/proc/cpuinfo') as f:
+            for line in f:
+                if line.startswith('flags'):
+                    return hashlib.sha256(
+                        ' '.join(sorted(line.split())).encode()
+                    ).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(platform.machine().encode()).hexdigest()[:10]
+
+
+def initialized_platforms() -> Tuple[str, ...]:
+    """The PJRT platforms live in this process.  An accelerator plugin
+    changes XLA:CPU codegen preferences (prefer-no-gather/scatter), so
+    CPU executables compiled with a plugin present are not loadable in a
+    plugin-free process — cache scopes must separate them."""
+    try:
+        return tuple(sorted(jax._src.xla_bridge.backends().keys()))
+    except Exception:  # noqa: BLE001 - never block caching on this
+        try:
+            return (jax.default_backend(),)
+        except Exception:  # noqa: BLE001
+            return ()
+
+
+def env_scope() -> Tuple:
+    """The codegen-relevant process environment: host CPU features plus
+    everything that steers XLA's machine-feature preferences."""
+    return (host_fingerprint(), os.environ.get('XLA_FLAGS', ''),
+            os.environ.get('JAX_PLATFORMS', ''), initialized_platforms())
+
+
+def executable_cache_key(fingerprint: str, packed: Dict[str, Any],
+                         extra: Tuple = ()) -> Optional[str]:
+    """Cache key for one (policy set, input signature, platform) combo.
+
+    Returns None when the entry could not be persisted safely:
+
+    * inputs sharded across >1 device (mesh path: executables embed the
+      device assignment — not portable);
+    * >1 local device on the backend (``deserialize_and_load`` reloads
+      executables across ALL local devices, so a 1-device executable
+      mis-loads as an N-shard SPMD program — verified on the
+      8-virtual-device CPU test env);
+    * non-CPU backends (serializing over a remote-TPU tunnel takes
+      minutes and starves the host mid-scan; accelerator recompiles
+      ride the persistent XLA compilation cache instead).
+    """
+    try:
+        sig = []
+        backend = jax.default_backend()
+        platform = backend
+        for name in sorted(packed):
+            v = packed[name]
+            sharding = getattr(v, 'sharding', None)
+            if sharding is not None:
+                devs = getattr(sharding, 'device_set', None)
+                if devs is not None:
+                    if len(devs) != 1:
+                        return None
+                    d = next(iter(devs))
+                    backend = d.platform
+                    # device kind + identity, not just the platform
+                    # name: topology/generation changes the executable
+                    platform = (f'{d.platform}:{getattr(d, "id", 0)}:'
+                                f'{getattr(d, "device_kind", "")}')
+            sig.append((name, str(v.dtype), tuple(v.shape)))
+        if len(jax.local_devices(backend=backend)) != 1:
+            return None
+        if backend != 'cpu':
+            return None
+        payload = repr((AOT_VERSION, source_digest(), jax.__version__,
+                        jax.lib.__version__, platform, fingerprint, sig,
+                        env_scope(), extra))
+        return hashlib.sha256(payload.encode()).hexdigest()[:32]
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return None
+
+
+# -- XLA persistent compilation cache ---------------------------------------
+
+_PERSISTENT_CACHE_ON = False
+
+
+def enable_persistent_compilation_cache() -> Optional[str]:
+    """Point XLA's persistent compilation cache at a disk directory so a
+    fresh process re-serving the same policy set skips the (multi-second)
+    backend compile even where AOT executables can't persist (mesh,
+    accelerators).  Keyed by XLA on the computation fingerprint, which
+    covers the (policy-set, chunk-shape) pair.  Idempotent; returns the
+    cache dir (or None when the runtime lacks the knobs)."""
+    global _PERSISTENT_CACHE_ON
+    # scope by host CPU features AND the codegen-relevant environment:
+    # a TPU-plugin process compiles its CPU executables with different
+    # machine-feature preferences (prefer-no-gather/scatter) than a
+    # pure-CPU process, and loading across that boundary aborts
+    scope = hashlib.sha256(repr(env_scope()).encode()).hexdigest()[:10]
+    cache_dir = os.environ.get(
+        'KTPU_COMPILE_CACHE',
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), '.cache',
+            f'xla-{scope}'))
+    if _PERSISTENT_CACHE_ON:
+        return cache_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        return None
+    _PERSISTENT_CACHE_ON = True
+    return cache_dir

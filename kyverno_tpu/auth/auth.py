@@ -28,6 +28,12 @@ _IRREGULAR_PLURALS = {
     'StorageClass': 'storageclasses',
     'PriorityClass': 'priorityclasses',
     'RuntimeClass': 'runtimeclasses',
+    'Gateway': 'gateways',
+    'HTTPRoute': 'httproutes',
+    'GRPCRoute': 'grpcroutes',
+    'ReferenceGrant': 'referencegrants',
+    'PodMetrics': 'pods',
+    'NodeMetrics': 'nodes',
 }
 
 
@@ -36,7 +42,11 @@ def _pluralize(kind: str) -> str:
     if irregular:
         return irregular
     low = kind.lower()
-    if low.endswith('y'):
+    # English pluralization only turns -y into -ies after a consonant
+    # (Policy → policies); vowel + y just appends s (Gateway →
+    # gateways) — the old unconditional rule produced 'gatewaies' and
+    # SSAR probes against a nonexistent GVR
+    if low.endswith('y') and len(low) > 1 and low[-2] not in 'aeiou':
         return low[:-1] + 'ies'
     if low.endswith(('s', 'x', 'z', 'ch', 'sh')):
         return low + 'es'

@@ -59,11 +59,18 @@ class AdmissionController:
         from ..controllers.policymetrics import PolicyMetricsController
         self.policy_metrics = PolicyMetricsController(
             setup.client, setup.metrics)
+        # background AOT warm-up: pre-compile (or pre-load from the
+        # persistent executable store) the admission graph for the
+        # installed enforce policy set before first traffic; readiness
+        # is reported through /health/warmup and the warm-duration
+        # histogram.  Requests serve the host engine loop meanwhile.
+        self.warmer = setup.start_aot_warmer(self._warm_admission)
         from ..webhooks.server import PolicyHandlers
         self.server = WebhookServer(
             self.handlers, configuration=setup.configuration,
             policy_handlers=PolicyHandlers(setup.client),
-            port=port, certfile=certfile, keyfile=keyfile)
+            port=port, certfile=certfile, keyfile=keyfile,
+            warmer=self.warmer)
         self.reconciler = WebhookConfigReconciler(
             setup.client, self.cert_renewer.ca_bundle(),
             setup.options.namespace)
@@ -71,6 +78,22 @@ class AdmissionController:
         if setup.options.leader_election:
             self.elector = LeaderElector(setup.client, 'kyverno',
                                          setup.options.namespace)
+
+    def _warm_admission(self):
+        """Warm-fn for the AOT warmer: build (or AOT-load) the compiled
+        scanner for the installed enforce policy set so the first real
+        admission request hits a serving executable."""
+        from ..policycache import cache as pcache
+        self.sync_policies()
+        enforce = self.cache.get_policies(pcache.VALIDATE_ENFORCE,
+                                          'Pod', '')
+        if not enforce:
+            return 'no enforce policies installed'
+        if not self.handlers.device:
+            return 'device path disabled'
+        ok = self.handlers.wait_device_ready(enforce, timeout=600.0)
+        return ('compiled scanner serving' if ok
+                else 'device path unavailable; host loop serves')
 
     def _create_ur(self, ur_spec: dict) -> None:
         from ..background.updaterequest import UpdateRequestGenerator

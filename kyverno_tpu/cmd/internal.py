@@ -67,6 +67,8 @@ class Setup:
             client = FakeClient()
         self.client = client
         self.stop_event = threading.Event()
+        # populated by start_aot_warmer (admission controller)
+        self.aot_warmer = None
         # profiling + tracing (reference: setup.go:21 setup order)
         self.profiling_server = None
         if getattr(self.options, 'profile', False):
@@ -77,6 +79,18 @@ class Setup:
         if getattr(self.options, 'enable_tracing', False):
             from ..observability import tracing
             tracing.configure()
+
+    def start_aot_warmer(self, warm_fn, name: str = 'admission'):
+        """Kick off the background AOT warm-up (pre-compile / pre-load
+        of the serving graph before first traffic).  Honors KTPU_WARM=0
+        (no thread, state 'disabled').  Returns the Warmer so callers
+        can report readiness (webhook health endpoints, benchmarks)."""
+        from ..aotcache.warmer import Warmer
+        registry = None if self.options.disable_metrics else self.metrics
+        warmer = Warmer(warm_fn, name=name, registry=registry)
+        warmer.start()
+        self.aot_warmer = warmer
+        return warmer
 
     def install_signal_handlers(self) -> None:
         def handler(signum, frame):

@@ -1,0 +1,144 @@
+"""AOT executable codec: serialize compiled evaluators to/from the
+persistent store.
+
+The persistent XLA compilation cache only skips the backend compile; a
+fresh process still pays ~10s re-tracing the evaluator (the jaxpr for a
+full policy pack lowers to ~4MB of StableHLO) plus the cache
+deserialize.  Serializing the *compiled executable*
+(``jax.experimental.serialize_executable``) keyed by
+:func:`kyverno_tpu.aotcache.keys.executable_cache_key` skips trace AND
+compile: a second process reaches device-served scans with zero fresh
+XLA compiles for a cached policy set.
+
+Blobs are ``codec byte + compressed pickle((payload, in_tree,
+out_tree))``; zstandard when available, stdlib zlib otherwise (the
+seed's hard zstandard dependency silently disabled the disk path on
+hosts without it).  Integrity framing and eviction live one layer down
+in :class:`kyverno_tpu.aotcache.store.AotStore` — a corrupt or
+stale-codec entry decodes as a miss and is dropped, never raised.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Optional
+
+from ..aotcache.keys import executable_cache_key  # noqa: F401 (re-export)
+from ..aotcache.store import AotStore, default_store
+
+_log = logging.getLogger('kyverno.aotcache')
+
+_CODEC_ZSTD = b'Z'
+_CODEC_ZLIB = b'D'
+
+
+def _zstd():
+    try:
+        import zstandard
+        return zstandard
+    except ImportError:
+        return None
+
+
+def encode_executable(compiled) -> bytes:
+    """compiled executable → compressed blob (raises on failure)."""
+    from jax.experimental import serialize_executable as se
+    payload, in_tree, out_tree = se.serialize(compiled)
+    raw = pickle.dumps((payload, in_tree, out_tree))
+    zstd = _zstd()
+    if zstd is not None:
+        return _CODEC_ZSTD + zstd.ZstdCompressor(level=3).compress(raw)
+    import zlib
+    return _CODEC_ZLIB + zlib.compress(raw, 3)
+
+
+def decode_executable(blob: bytes) -> Any:
+    """blob → loaded executable (raises on any mismatch — callers
+    treat that as a miss and drop the entry)."""
+    from jax.experimental import serialize_executable as se
+    codec, body = blob[:1], blob[1:]
+    if codec == _CODEC_ZSTD:
+        import zstandard
+        raw = zstandard.ZstdDecompressor().decompress(body)
+    elif codec == _CODEC_ZLIB:
+        import zlib
+        raw = zlib.decompress(body)
+    else:
+        raise ValueError(f'unknown aot codec {codec!r}')
+    payload, in_tree, out_tree = pickle.loads(raw)
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+# -- store orchestration ------------------------------------------------------
+
+def load_executable(key: str, store: Optional[AotStore] = None) -> Any:
+    """Loaded executable for ``key`` or None.  A blob that fails to
+    decode (stale jax, torn write below the framing's resolution) is
+    deleted so the next process recompiles instead of re-failing."""
+    store = store or default_store()
+    blob = store.load(key)
+    if blob is None:
+        return None
+    try:
+        return decode_executable(blob)
+    except Exception:  # noqa: BLE001 - stale/corrupt entry: recompile
+        _log.warning('aot entry %s undecodable; dropping', key[:12])
+        store.delete(key)
+        return None
+
+
+#: in-flight background stores; flush_stores() joins them (tests, and
+#: warmers that want the entry on disk before declaring readiness)
+_STORE_THREADS: set = set()
+_STORE_THREADS_LOCK = threading.Lock()
+
+
+def store_executable_async(key: str, compiled,
+                           store: Optional[AotStore] = None) -> None:
+    """Serialize + write in a daemon thread (~40MB compressed for a
+    full-pack chunk executable; must not block the scan path)."""
+    store = store or default_store()
+    if not store.enabled:
+        return
+
+    def work():
+        try:
+            store.put(key, encode_executable(compiled))
+        except Exception:  # noqa: BLE001 - cache write is best-effort
+            pass
+        finally:
+            with _STORE_THREADS_LOCK:
+                _STORE_THREADS.discard(threading.current_thread())
+
+    t = threading.Thread(target=work, daemon=True,
+                         name=f'aot-store-{key[:8]}')
+    with _STORE_THREADS_LOCK:
+        _STORE_THREADS.add(t)
+    t.start()
+
+
+def flush_stores(timeout: float = 120.0) -> None:
+    """Join outstanding background stores (bounded per thread)."""
+    with _STORE_THREADS_LOCK:
+        threads = list(_STORE_THREADS)
+    for t in threads:
+        t.join(timeout)
+
+
+def evict_executable(key: str, store: Optional[AotStore] = None) -> None:
+    """Drop a poisoned entry from disk so the next call recompiles."""
+    (store or default_store()).delete(key)
+
+
+def warm_cache_dir() -> Optional[str]:
+    """The active store directory (diagnostics / README numbers)."""
+    s = default_store()
+    return s.root
+
+
+def aot_enabled() -> bool:
+    return default_store().enabled and \
+        os.environ.get('KTPU_AOT', '1') == '1'

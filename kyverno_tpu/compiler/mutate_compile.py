@@ -172,8 +172,10 @@ def compile_json6902(patch_text: Any) -> Optional[CompiledMutation]:
     except Exception:  # noqa: BLE001 - engine reports the parse error
         return None
     sets: List[Tuple[Tuple[str, ...], bool, Any]] = []
+    replace_paths: List[Tuple[str, ...]] = []
     for op in ops:
-        if (op or {}).get('op') not in ('add', 'replace'):
+        op_name = (op or {}).get('op')
+        if op_name not in ('add', 'replace'):
             return None
         path = str(op.get('path', ''))
         parts = tuple(p.replace('~1', '/').replace('~0', '~')
@@ -182,9 +184,21 @@ def compile_json6902(patch_text: Any) -> Optional[CompiledMutation]:
             return None  # array-index ops keep the engine path
         if not _static(op.get('value')):
             return None
+        if op_name == 'replace':
+            replace_paths.append(parts)
         sets.append((parts, False, op.get('value')))
 
     def apply(doc: dict):
+        # `replace` requires the leaf AND every intermediate to exist —
+        # the engine FAILs with "replace path not found"; only `add`
+        # may create paths.  FALLBACK re-runs the engine for the exact
+        # failure response.
+        for parts in replace_paths:
+            cur: Any = doc
+            for part in parts:
+                if not isinstance(cur, dict) or part not in cur:
+                    return FALLBACK
+                cur = cur[part]
         result = _apply_sets(doc, sets)
         if result is FALLBACK:
             return FALLBACK
@@ -311,6 +325,14 @@ def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]
             cur = cur.get(part)
         if not isinstance(cur, list) or \
                 not all(isinstance(e, dict) for e in cur):
+            return FALLBACK
+        # the engine's strategic merge matches overlay entries to list
+        # elements BY NAME and coalesces duplicates onto the first
+        # occurrence; the fast path patches elements independently, so
+        # duplicate (or non-string) names must take the engine path
+        names = [e.get('name') for e in cur]
+        if any(not isinstance(n, str) for n in names) or \
+                len(set(names)) != len(names):
             return FALLBACK
         new_list = None
         for i, element in enumerate(cur):

@@ -42,8 +42,10 @@ def mutate_rule(rule_raw: dict, ctx: Context, resource: dict) -> MutateResponse:
     (reference: pkg/engine/mutate/mutation.go:38 Mutate)."""
     try:
         if vars_mod.tree_has_variables(rule_raw):
-            # substitute_all rebuilds every dict/list node, so the input
-            # is never aliased into the output — no pre-copy needed
+            # substitute_all output may ALIAS the rule tree (static
+            # subtrees are returned by reference via _STATIC_TREES) —
+            # safe only because it is treated read-only here and every
+            # downstream applier copies before mutating
             updated_rule = vars_mod.substitute_all(ctx, rule_raw)
         else:
             # constant rule: substitution is the identity, and every

@@ -108,7 +108,14 @@ def _scan_vars(doc: Any) -> bool:
 
 def substitute_all(ctx: Context, document: Any) -> Any:
     """Substitute references then variables across a JSON document
-    (reference: pkg/engine/variables/vars.go:82 SubstituteAll)."""
+    (reference: pkg/engine/variables/vars.go:82 SubstituteAll).
+
+    The output is READ-ONLY and may alias ``document``: subtrees with
+    no variables/references are returned by reference (the
+    ``_STATIC_TREES`` fast path in ``_traverse``), so mutating the
+    result in place would corrupt the shared rule tree for every later
+    resource.  Consumers must copy before mutating (the engine's
+    appliers all do)."""
     document = substitute_references(document)
     return substitute_vars(ctx, document, default_resolver)
 

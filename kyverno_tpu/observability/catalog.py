@@ -49,4 +49,13 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_d2h_stalls_total': Metric(
         'counter', 'Readbacks exceeding the stall watchdog threshold '
         '(KTPU_D2H_STALL_S, default 30s).'),
+    # AOT cache + warm-up instruments (aotcache/)
+    'kyverno_tpu_aot_warm_duration_seconds': Metric(
+        'histogram', 'Background warm-up wall time by target/state '
+        '(aotcache/warmer.py).'),
+    'kyverno_tpu_aot_cache_size_bytes': Metric(
+        'gauge', 'Bytes of persisted AOT executables on disk '
+        '(KTPU_AOT_CACHE_DIR).'),
+    'kyverno_tpu_aot_cache_entries': Metric(
+        'gauge', 'Persisted AOT executable entries on disk.'),
 }

@@ -1134,280 +1134,16 @@ def cond_tf(t: Dict[str, Any], prefix: str, check: CondCheck) -> _K:
 
 
 # ---------------------------------------------------------------------------
-# evaluator assembly
+# evaluator assembly.  Compiled-executable persistence lives in the
+# aotcache subsystem (kyverno_tpu/aotcache + compiler/aot.py): every
+# jit site below consults the disk store before paying a fresh trace +
+# XLA compile, and stores what it compiled for the next process.  The
+# cache-key helpers are re-exported here because this module
+# historically owned them (and the evaluator is their main consumer).
 
-_PERSISTENT_CACHE_ON = False
+from ..aotcache.keys import (enable_persistent_compilation_cache,  # noqa: E402,F401
+                             policy_set_fingerprint)
 
-
-def _host_fingerprint() -> str:
-    """Short hash of the host CPU feature set.  XLA:CPU AOT artifacts
-    embed the compile machine's features and can SIGILL when loaded on a
-    host missing them; scoping the cache dir per feature set keeps a
-    shared checkout safe across heterogeneous machines."""
-    import hashlib
-    try:
-        with open('/proc/cpuinfo') as f:
-            for line in f:
-                if line.startswith('flags'):
-                    return hashlib.sha256(
-                        ' '.join(sorted(line.split())).encode()
-                    ).hexdigest()[:10]
-    except OSError:
-        pass
-    import platform
-    return hashlib.sha256(platform.machine().encode()).hexdigest()[:10]
-
-
-def _initialized_platforms() -> Tuple[str, ...]:
-    """The PJRT platforms live in this process.  An accelerator plugin
-    changes XLA:CPU codegen preferences (prefer-no-gather/scatter), so
-    CPU executables compiled with a plugin present are not loadable in a
-    plugin-free process — cache scopes must separate them."""
-    try:
-        return tuple(sorted(jax._src.xla_bridge.backends().keys()))
-    except Exception:  # noqa: BLE001 - never block caching on this
-        try:
-            return (jax.default_backend(),)
-        except Exception:  # noqa: BLE001
-            return ()
-
-
-def enable_persistent_compilation_cache() -> Optional[str]:
-    """Point XLA's persistent compilation cache at a disk directory so a
-    fresh process re-serving the same policy set skips the (multi-second)
-    evaluator compile.  Keyed by XLA on the computation fingerprint, which
-    covers the (policy-set, chunk-shape) pair.  Idempotent; returns the
-    cache dir (or None when the runtime lacks the knobs)."""
-    global _PERSISTENT_CACHE_ON
-    # scope by host CPU features AND the codegen-relevant environment:
-    # a TPU-plugin process compiles its CPU executables with different
-    # machine-feature preferences (prefer-no-gather/scatter) than a
-    # pure-CPU process, and loading across that boundary aborts
-    import hashlib as _hashlib
-    env_scope = _hashlib.sha256(repr(
-        (_host_fingerprint(), os.environ.get('XLA_FLAGS', ''),
-         os.environ.get('JAX_PLATFORMS', ''),
-         _initialized_platforms())).encode()).hexdigest()[:10]
-    cache_dir = os.environ.get(
-        'KTPU_COMPILE_CACHE',
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), '.cache',
-            f'xla-{env_scope}'))
-    if _PERSISTENT_CACHE_ON:
-        return cache_dir
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update('jax_compilation_cache_dir', cache_dir)
-        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
-        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
-    except Exception:  # noqa: BLE001 - cache is an optimization only
-        return None
-    _PERSISTENT_CACHE_ON = True
-    return cache_dir
-
-
-# ---------------------------------------------------------------------------
-# AOT executable cache.  The persistent XLA compilation cache only skips
-# the backend compile; a fresh process still pays ~10s re-tracing the
-# evaluator (the jaxpr for a full policy pack lowers to ~4MB of
-# StableHLO) plus the cache deserialize.  Serializing the *compiled
-# executable* (jax.experimental.serialize_executable) keyed by
-# (policy-set fingerprint, input signature, platform) skips trace AND
-# compile: a fresh process reaches device-served scans in seconds.
-
-_AOT_VERSION = 1
-_SOURCE_DIGEST: Optional[str] = None
-
-
-def _source_digest() -> str:
-    """Digest of the compiler/evaluator sources: any code change
-    invalidates AOT entries (the executable bakes in their semantics)."""
-    global _SOURCE_DIGEST
-    if _SOURCE_DIGEST is None:
-        import hashlib
-        h = hashlib.sha256()
-        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for rel in ('ops/eval.py', 'compiler/compile.py',
-                    'compiler/encode.py', 'compiler/ir.py',
-                    'compiler/pss_compile.py'):
-            try:
-                with open(os.path.join(base, rel), 'rb') as f:
-                    h.update(f.read())
-            except OSError:
-                h.update(rel.encode())
-        _SOURCE_DIGEST = h.hexdigest()[:16]
-    return _SOURCE_DIGEST
-
-
-def policy_set_fingerprint(policies) -> str:
-    """Stable digest of a policy set's raw documents (the evaluator HLO
-    is a deterministic function of them — verified cross-process)."""
-    import hashlib
-    import json
-    payload = json.dumps([getattr(p, 'raw', p) for p in policies],
-                         sort_keys=True, separators=(',', ':'),
-                         default=str)
-    return hashlib.sha256(payload.encode()).hexdigest()[:20]
-
-
-def _aot_cache_dir() -> Optional[str]:
-    if os.environ.get('KTPU_AOT', '1') != '1':
-        return None
-    d = os.environ.get(
-        'KTPU_AOT_CACHE',
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), '.cache', 'aot'))
-    try:
-        os.makedirs(d, exist_ok=True)
-    except OSError:
-        return None
-    return d
-
-
-def _aot_key(fingerprint: str, packed: Dict[str, Any]) -> Optional[str]:
-    """Cache key for one (policy set, input signature, platform) combo.
-    Returns None when the inputs are sharded across >1 device (mesh
-    path: executables embed the device assignment — not portable)."""
-    import hashlib
-    try:
-        sig = []
-        backend = jax.default_backend()
-        platform = backend
-        for name in sorted(packed):
-            v = packed[name]
-            sharding = getattr(v, 'sharding', None)
-            if sharding is not None:
-                devs = getattr(sharding, 'device_set', None)
-                if devs is not None:
-                    if len(devs) != 1:
-                        return None
-                    d = next(iter(devs))
-                    backend = d.platform
-                    platform = f'{d.platform}:{getattr(d, "id", 0)}'
-            sig.append((name, str(v.dtype), tuple(v.shape)))
-        # deserialize_and_load reloads executables across ALL local
-        # devices of the backend: a 1-device executable mis-loads as an
-        # N-shard SPMD program on multi-device hosts (verified on the
-        # 8-virtual-device CPU test env) — AOT only on 1-device backends
-        if len(jax.local_devices(backend=backend)) != 1:
-            return None
-        # serialize_executable round-trips the accelerator runtime: over
-        # a remote-TPU tunnel one executable takes MINUTES to serialize,
-        # starving the (single) host CPU mid-scan.  AOT only for local
-        # CPU executables (the admission path); accelerator recompiles
-        # ride the persistent XLA compilation cache instead.
-        if backend != 'cpu':
-            return None
-        # XLA:CPU codegen bakes in machine-feature preferences that vary
-        # with the process environment (a TPU-plugin process compiles its
-        # CPU executables with prefer-no-gather/scatter; a pure-CPU
-        # process does not) — a cross-environment load runs but fails at
-        # execute time.  Scope the key by host features, the ambient XLA
-        # flags, and the set of initialized platforms.
-        env_scope = (_host_fingerprint(), os.environ.get('XLA_FLAGS', ''),
-                     jax.default_backend(),
-                     os.environ.get('JAX_PLATFORMS', ''),
-                     _initialized_platforms())
-        payload = repr((_AOT_VERSION, _source_digest(), jax.__version__,
-                        jax.lib.__version__, platform, fingerprint, sig,
-                        env_scope,
-                        os.environ.get('KTPU_FDET_K', '32')))
-        return hashlib.sha256(payload.encode()).hexdigest()[:32]
-    except Exception:  # noqa: BLE001 - cache is an optimization only
-        return None
-
-
-def _aot_load(key: str):
-    d = _aot_cache_dir()
-    if d is None:
-        return None
-    path = os.path.join(d, f'{key}.exe.zst')
-    if not os.path.exists(path):
-        return None
-    try:
-        import pickle
-        import zstandard
-        from jax.experimental import serialize_executable as se
-        with open(path, 'rb') as f:
-            blob = zstandard.ZstdDecompressor().decompress(f.read())
-        payload, in_tree, out_tree = pickle.loads(blob)
-        loaded = se.deserialize_and_load(payload, in_tree, out_tree)
-    except Exception:  # noqa: BLE001 - stale/corrupt entry: recompile
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
-        return None
-    try:
-        os.utime(path)  # LRU eviction works off mtime
-    except OSError:  # a touch failure must not void a good load
-        pass
-    return loaded
-
-
-def _aot_store_async(key: str, compiled) -> None:
-    """Serialize + write in a daemon thread (~40MB compressed for a
-    full-pack chunk executable; must not block the scan path)."""
-    d = _aot_cache_dir()
-    if d is None:
-        return
-
-    def work():
-        try:
-            import pickle
-            import tempfile
-            import zstandard
-            from jax.experimental import serialize_executable as se
-            payload, in_tree, out_tree = se.serialize(compiled)
-            blob = zstandard.ZstdCompressor(level=3).compress(
-                pickle.dumps((payload, in_tree, out_tree)))
-            _aot_evict(d, budget=int(os.environ.get(
-                'KTPU_AOT_CACHE_MAX', str(8 << 30))) - len(blob))
-            fd, tmp = tempfile.mkstemp(dir=d, suffix='.tmp')
-            with os.fdopen(fd, 'wb') as f:
-                f.write(blob)
-            os.replace(tmp, os.path.join(d, f'{key}.exe.zst'))
-        except Exception:  # noqa: BLE001 - cache write is best-effort
-            pass
-
-    import threading
-    threading.Thread(target=work, daemon=True,
-                     name=f'aot-store-{key[:8]}').start()
-
-
-def _aot_evict(d: str, budget: int) -> None:
-    """Drop oldest entries until the directory fits the byte budget."""
-    try:
-        import time as _time
-        entries = []
-        for name in os.listdir(d):
-            p = os.path.join(d, name)
-            if name.endswith('.tmp'):
-                # orphaned partial writes from killed processes — the
-                # atomic-rename protocol never leaves a fresh .tmp behind
-                # for long, so stale ones are garbage
-                try:
-                    if _time.time() - os.stat(p).st_mtime > 600:
-                        os.unlink(p)
-                except OSError:
-                    pass
-                continue
-            if not name.endswith('.exe.zst'):
-                continue
-            st = os.stat(p)
-            entries.append((st.st_mtime, st.st_size, p))
-        entries.sort()
-        total = sum(sz for _, sz, _ in entries)
-        for _, sz, p in entries:
-            if total <= max(budget, 0):
-                break
-            try:
-                os.unlink(p)
-                total -= sz
-            except OSError:
-                pass
-    except OSError:
-        pass
 
 
 def build_evaluator(cps: CompiledPolicySet):
@@ -1960,8 +1696,10 @@ def build_evaluator(cps: CompiledPolicySet):
         """Executable for this input signature: memory → AOT disk →
         trace+compile (and populate both).  None → mesh-sharded inputs
         or AOT disabled; caller falls back to the jitted path."""
+        from ..compiler import aot
         from ..observability import device as devtel
-        key = _aot_key(fingerprint, packed)
+        key = aot.executable_cache_key(fingerprint, packed,
+                                       extra=(str(fdet_k),))
         if key is None:
             return None
         with compile_lock:
@@ -1970,7 +1708,7 @@ def build_evaluator(cps: CompiledPolicySet):
                 devtel.record_cache('hit')
                 return hit
             with devtel.stage('compile') as st:
-                loaded = _aot_load(key)
+                loaded = aot.load_executable(key)
                 if loaded is not None:
                     devtel.record_cache('aot_load')
                     st.set_attribute('cache', 'aot_load')
@@ -1979,7 +1717,7 @@ def build_evaluator(cps: CompiledPolicySet):
                     loaded = jitted.lower(packed).compile()
                     devtel.record_cache('miss')
                     st.set_attribute('cache', 'miss')
-                    _aot_store_async(key, loaded)
+                    aot.store_executable_async(key, loaded)
                     devtel.record_cache('aot_store')
             exec_cache[key] = loaded
             return loaded
@@ -1987,17 +1725,14 @@ def build_evaluator(cps: CompiledPolicySet):
     def _evict_aot(packed) -> None:
         """Drop a poisoned AOT entry (memory + disk) so the next call
         recompiles instead of re-failing."""
-        key = _aot_key(fingerprint, packed)
+        from ..compiler import aot
+        key = aot.executable_cache_key(fingerprint, packed,
+                                       extra=(str(fdet_k),))
         if key is None:
             return
         with compile_lock:
             exec_cache.pop(key, None)
-        d = _aot_cache_dir()
-        if d is not None:
-            try:
-                os.unlink(os.path.join(d, f'{key}.exe.zst'))
-            except OSError:
-                pass
+        aot.evict_executable(key)
 
     def call(packed: Dict[str, Any],
              layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]]):

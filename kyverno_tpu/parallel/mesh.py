@@ -43,8 +43,14 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     all-reduce that replaces the reference's report aggregation fan-in
     (reference: pkg/controllers/report/aggregate/controller.go).
     """
+    from ..aotcache import enable_persistent_compilation_cache
     from ..compiler.ir import N_STATUS_CODES
     from ..ops.eval import build_evaluator, enable_x64, unpack_batch
+    # sharded executables embed the mesh's device assignment, so the
+    # AOT executable store cannot persist them; the XLA persistent
+    # compilation cache (keyed on the computation fingerprint) still
+    # skips the backend compile for a fresh process on the same mesh
+    enable_persistent_compilation_cache()
     evaluator = build_evaluator(cps)
     n_codes = N_STATUS_CODES
 
@@ -69,14 +75,30 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
     # input shardings propagate from the device_put placement in
     # shard_tensors; only outputs are constrained here
     jitted = jax.jit(step, out_shardings=out_shardings)
+    # signatures this sharded jit has traced, mirroring the evaluator's
+    # own hit/miss telemetry so the mesh path's compiles show up in the
+    # kyverno_tpu_compile_cache counters too
+    jit_seen: set = set()
 
     def run(tensors, layout):
+        from ..observability import device as devtel
         # layout_holder is shared with the single-device evaluator's
         # traces — take its compile lock so a concurrent call cannot
         # bake this layout into the wrong executable
         with evaluator.compile_lock:
             evaluator.layout_holder['layout'] = layout
             with enable_x64():
+                if devtel.enabled():
+                    sig = tuple((k, str(v.dtype), tuple(v.shape))
+                                for k, v in sorted(tensors.items()))
+                    if sig not in jit_seen:
+                        jit_seen.add(sig)
+                        devtel.record_cache('miss')
+                        with devtel.stage('compile') as st:
+                            st.set_attribute('cache', 'miss')
+                            st.set_attribute('mesh', True)
+                            return jitted(tensors)
+                    devtel.record_cache('hit')
                 return jitted(tensors)
 
     return run

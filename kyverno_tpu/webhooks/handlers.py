@@ -356,16 +356,9 @@ class ResourceHandlers:
                 from ..compiler.scan import BatchScanner
                 scanner = BatchScanner(policies, engine=self.engine)
                 # pre-warm the small-batch shape an admission request
-                # hits: XLA compiles per shape bucket, and the element
-                # axis clamps to a minimum of 4 — a ≤4-container warm
-                # pod covers every ≤4-container request (the common
-                # case); larger pods lazily compile their bucket once
-                warm = {'apiVersion': 'v1', 'kind': 'Pod',
-                        'metadata': {'name': 'warm', 'namespace': 'default'},
-                        'spec': {'containers': [
-                            {'name': f'c{i}', 'image': 'warm:1'}
-                            for i in range(2)]}}
-                scanner.scan([warm])
+                # hits (AOT-loads from the persistent executable store
+                # when a prior process already compiled this set)
+                scanner.warmup()
                 with self._scanner_lock:
                     while len(self._scanners) >= self._scanners_max:
                         self._scanners.popitem(last=False)

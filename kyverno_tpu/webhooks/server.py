@@ -73,7 +73,8 @@ class WebhookServer:
                  dump: bool = False,
                  host: str = '127.0.0.1', port: int = 9443,
                  certfile: Optional[str] = None,
-                 keyfile: Optional[str] = None):
+                 keyfile: Optional[str] = None,
+                 warmer=None):
         self.resource_handlers = resource_handlers
         self.policy_handlers = policy_handlers or PolicyHandlers()
         self.exception_handlers = exception_handlers or ExceptionHandlers()
@@ -86,6 +87,10 @@ class WebhookServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ready = False
+        # aotcache.warmer.Warmer (or None): /health/warmup reports its
+        # state.  Warm-up never gates /health/readiness — the host
+        # engine loop serves identical verdicts while compiling.
+        self.warmer = warmer
         if protection_enabled is None:
             # env-tier feature toggle (reference: pkg/toggle/toggle.go:21
             # ProtectManagedResources, consumed by handlers/protect.go)
@@ -156,6 +161,21 @@ class WebhookServer:
         return json.dumps(
             admission.review_response(request, resp)).encode('utf-8')
 
+    def warmup_status(self):
+        """(json body, http status) for /health/warmup."""
+        w = self.warmer
+        if w is None:
+            return {'state': 'disabled'}, 200
+        body = {'state': w.state}
+        if w.duration_s is not None:
+            body['duration_s'] = round(w.duration_s, 3)
+        if w.detail:
+            body['detail'] = w.detail
+        if w.error:
+            body['error'] = w.error
+        return body, 200 if w.state in ('ready', 'disabled', 'failed') \
+            else 503
+
     # -- http lifecycle ---------------------------------------------------
 
     def start(self) -> None:
@@ -171,6 +191,20 @@ class WebhookServer:
                     self.send_response(200 if ok else 503)
                     self.end_headers()
                     self.wfile.write(b'ok' if ok else b'not ready')
+                    return
+                if self.path == '/health/warmup':
+                    # 200 once the warm pass finished (ready), was
+                    # disabled, or failed (serving is unaffected: the
+                    # host loop covers it); 503 only while in flight —
+                    # deployments that want compiled-path latency from
+                    # the first request gate rollout on this endpoint
+                    body, code = server.warmup_status()
+                    payload = json.dumps(body).encode('utf-8')
+                    self.send_response(code)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                     return
                 self.send_response(404)
                 self.end_headers()

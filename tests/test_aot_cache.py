@@ -1,0 +1,326 @@
+"""Persistent AOT executable cache + background warm-up (ISSUE 2).
+
+Store semantics (hit/miss, corruption tolerance, LRU eviction, atomic
+writes), cache-key scoping (policy set / version mismatch / multi-
+device refusal), warmer lifecycle (including the KTPU_WARM=0 no-op),
+and the acceptance criterion: a second process starting against a
+populated cache performs ZERO fresh XLA compiles for the cached policy
+set (asserted via the kyverno_tpu_compile_cache aot_load/miss
+counters), with bit-identical scan output vs the uncached path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.aotcache import keys as aot_keys
+from kyverno_tpu.aotcache.store import AotStore, reset_default_store
+from kyverno_tpu.aotcache.warmer import Warmer
+from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                               set_global_registry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_default_store():
+    reset_default_store()
+    yield
+    reset_default_store()
+    set_global_registry(None)
+
+
+# ---------------------------------------------------------------------------
+# store
+
+
+class TestStore:
+    def test_miss_then_hit(self, tmp_path):
+        store = AotStore(root=str(tmp_path))
+        assert store.load('k' * 32) is None          # miss
+        assert store.put('k' * 32, b'payload-bytes')
+        assert store.load('k' * 32) == b'payload-bytes'  # hit
+        st = store.stats()
+        assert st['entries'] == 1 and st['bytes'] > len(b'payload-bytes')
+
+    def test_corrupt_entry_dropped_not_crashed(self, tmp_path):
+        store = AotStore(root=str(tmp_path))
+        store.put('deadbeef', b'x' * 256)
+        path = store.path('deadbeef')
+        raw = bytearray(open(path, 'rb').read())
+        raw[-1] ^= 0xFF  # flip a payload bit under the digest
+        open(path, 'wb').write(bytes(raw))
+        assert store.load('deadbeef') is None
+        assert not os.path.exists(path), 'corrupt entry must be deleted'
+        # truncated-below-header entries are equally a miss
+        open(store.path('cafe'), 'wb').write(b'KT')
+        assert store.load('cafe') is None
+        assert not os.path.exists(store.path('cafe'))
+
+    def test_lru_eviction_respects_byte_budget(self, tmp_path):
+        blob = b'z' * 1000
+        frame = 38  # magic + sha256
+        store = AotStore(root=str(tmp_path),
+                         max_bytes=3 * (len(blob) + frame))
+        now = time.time()
+        for i, key in enumerate(('old', 'mid', 'new')):
+            store.put(key, blob)
+            os.utime(store.path(key), (now - 100 + i, now - 100 + i))
+        store.put('newest', blob)  # over budget: LRU ('old') evicted
+        assert store.load('old') is None
+        assert store.load('mid') is not None
+        assert store.load('newest') is not None
+        assert store.stats()['entries'] == 3
+
+    def test_load_refreshes_lru_position(self, tmp_path):
+        blob = b'z' * 1000
+        store = AotStore(root=str(tmp_path), max_bytes=3 * 1100)
+        now = time.time()
+        for i, key in enumerate(('a', 'b', 'c')):
+            store.put(key, blob)
+            os.utime(store.path(key), (now - 100 + i, now - 100 + i))
+        store.load('a')  # touch: 'a' becomes most-recent, 'b' is LRU
+        store.put('d', blob)
+        assert store.load('b') is None
+        assert store.load('a') is not None
+
+    def test_atomic_writes_leave_no_tmp(self, tmp_path):
+        store = AotStore(root=str(tmp_path))
+        for i in range(5):
+            store.put(f'key{i}', os.urandom(2048))
+        assert not [n for n in os.listdir(tmp_path) if n.endswith('.tmp')]
+
+    def test_env_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('KTPU_AOT_CACHE_DIR', str(tmp_path / 'via-env'))
+        store = AotStore()
+        assert store.root == str(tmp_path / 'via-env')
+        monkeypatch.setenv('KTPU_AOT', '0')
+        assert not AotStore().enabled
+
+    def test_publishes_size_gauges(self, tmp_path):
+        reg = MetricsRegistry()
+        set_global_registry(reg)
+        store = AotStore(root=str(tmp_path))
+        store.put('k1', b'x' * 100)
+        assert reg.gauge_value('kyverno_tpu_aot_cache_entries') == 1.0
+        assert reg.gauge_value('kyverno_tpu_aot_cache_size_bytes') > 100
+
+    def test_undecodable_blob_is_evicted_by_loader(self, tmp_path):
+        from kyverno_tpu.compiler import aot
+        store = AotStore(root=str(tmp_path))
+        store.put('badcodec', b'Qnot-a-real-codec-blob')
+        assert aot.load_executable('badcodec', store=store) is None
+        assert store.load('badcodec') is None, 'bad entry must be dropped'
+
+
+# ---------------------------------------------------------------------------
+# keys
+
+
+def _single_device(monkeypatch):
+    monkeypatch.setattr(aot_keys.jax, 'local_devices',
+                        lambda backend=None: [object()])
+
+
+class TestKeys:
+    PACKED = {'pk_int8': np.zeros((4, 8), np.int8),
+              'pk_float64': np.zeros((4, 2), np.float64)}
+
+    def test_key_scopes_policy_set_and_version(self, monkeypatch):
+        _single_device(monkeypatch)
+        k1 = aot_keys.executable_cache_key('fp-one', self.PACKED)
+        k2 = aot_keys.executable_cache_key('fp-two', self.PACKED)
+        assert k1 and k2 and k1 != k2
+        # version-key mismatch: a format bump invalidates every entry
+        monkeypatch.setattr(aot_keys, 'AOT_VERSION',
+                            aot_keys.AOT_VERSION + 1)
+        k1_v2 = aot_keys.executable_cache_key('fp-one', self.PACKED)
+        assert k1_v2 and k1_v2 != k1
+
+    def test_version_mismatch_misses_in_store(self, tmp_path, monkeypatch):
+        _single_device(monkeypatch)
+        store = AotStore(root=str(tmp_path))
+        k_old = aot_keys.executable_cache_key('fp', self.PACKED)
+        store.put(k_old, b'serialized-under-old-version')
+        monkeypatch.setattr(aot_keys, 'AOT_VERSION',
+                            aot_keys.AOT_VERSION + 1)
+        k_new = aot_keys.executable_cache_key('fp', self.PACKED)
+        assert store.load(k_new) is None    # stale entry never loads
+        assert store.load(k_old) is not None  # ...but is not destroyed
+
+    def test_key_scopes_batch_layout(self, monkeypatch):
+        _single_device(monkeypatch)
+        other = {'pk_int8': np.zeros((8, 8), np.int8),
+                 'pk_float64': np.zeros((8, 2), np.float64)}
+        assert aot_keys.executable_cache_key('fp', self.PACKED) != \
+            aot_keys.executable_cache_key('fp', other)
+
+    def test_multi_device_host_refuses_key(self):
+        # the tier-1 env forces 8 virtual CPU devices; deserialize_and_
+        # load would mis-load a 1-device executable as 8-shard SPMD
+        import jax
+        if len(jax.local_devices(backend='cpu')) == 1:
+            pytest.skip('env has a single CPU device')
+        assert aot_keys.executable_cache_key('fp', self.PACKED) is None
+
+    def test_fingerprint_stable(self):
+        fp = aot_keys.policy_set_fingerprint
+        a = [{'spec': {'rules': [1]}, 'metadata': {'name': 'x'}}]
+        b = [{'metadata': {'name': 'x'}, 'spec': {'rules': [1]}}]
+        assert fp(a) == fp(b)          # key order never matters
+        assert fp(a) != fp([{'metadata': {'name': 'y'}}])
+
+
+# ---------------------------------------------------------------------------
+# warmer
+
+
+class TestWarmer:
+    def test_noop_when_disabled(self, monkeypatch):
+        monkeypatch.setenv('KTPU_WARM', '0')
+        calls = []
+        w = Warmer(lambda: calls.append(1))
+        assert w.start() is False
+        assert w.state == 'disabled'
+        assert w.wait(0.1) is True       # never blocks callers
+        assert not calls, 'warm_fn must not run when disabled'
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith('ktpu-aot-warmer')]
+
+    def test_ready_records_duration_histogram(self):
+        reg = MetricsRegistry()
+        w = Warmer(lambda: 'warmed 3 executables', registry=reg,
+                   enabled=True)
+        assert w.start() is True
+        assert w.wait(10.0)
+        assert w.state == 'ready' and w.ready
+        assert w.detail == 'warmed 3 executables'
+        assert reg.histogram_count('kyverno_tpu_aot_warm_duration_seconds',
+                                   target='admission', state='ready') == 1
+
+    def test_failure_is_contained(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError('no backend')
+        w = Warmer(boom, name='scan', registry=reg, enabled=True)
+        w.run_sync()
+        assert w.state == 'failed' and not w.ready
+        assert 'no backend' in w.error
+        assert reg.histogram_count('kyverno_tpu_aot_warm_duration_seconds',
+                                   target='scan', state='failed') == 1
+
+    def test_start_is_idempotent(self):
+        calls = []
+        w = Warmer(lambda: calls.append(1) or 'ok', enabled=True)
+        assert w.start() and w.start()
+        w.wait(10.0)
+        assert calls == [1]
+
+    def test_setup_starts_warmer(self):
+        from kyverno_tpu.cmd.internal import Setup
+        setup = Setup('t', args=['--disable-metrics'])
+        w = setup.start_aot_warmer(lambda: 'scanner serving')
+        assert setup.aot_warmer is w
+        assert w.wait(10.0) and w.state == 'ready'
+        assert w.detail == 'scanner serving'
+
+    def test_webhook_warmup_status(self):
+        from types import SimpleNamespace
+        from kyverno_tpu.webhooks.server import WebhookServer
+        status = WebhookServer.warmup_status
+        body, code = status(SimpleNamespace(warmer=None))
+        assert (body['state'], code) == ('disabled', 200)
+        w = Warmer(lambda: 'ok', enabled=True)
+        body, code = status(SimpleNamespace(warmer=w))
+        assert (body['state'], code) == ('pending', 503)
+        w.run_sync()
+        body, code = status(SimpleNamespace(warmer=w))
+        assert (body['state'], code) == ('ready', 200)
+        assert 'duration_s' in body
+
+
+# ---------------------------------------------------------------------------
+# acceptance: second process = zero fresh compiles, bit-identical output
+
+_SECOND_PROC_SCRIPT = r'''
+import json, sys
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.observability import device as devtel
+from kyverno_tpu.observability.metrics import MetricsRegistry
+
+POLICY = {
+    'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+    'metadata': {'name': 'require-labels', 'annotations': {
+        'pod-policies.kyverno.io/autogen-controllers': 'none'}},
+    'spec': {'validationFailureAction': 'Enforce', 'rules': [
+        {'name': 'check-app',
+         'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+         'validate': {'message': 'app label required',
+                      'pattern': {'metadata': {'labels': {'app': '?*'}}}}},
+    ]}}
+
+
+def pod(i):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': f'p{i}', 'namespace': 'default',
+                         'labels': {'app': 'x'} if i % 2 else {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx:1'}]}}
+
+
+reg = devtel.configure(MetricsRegistry())
+from kyverno_tpu.compiler.scan import BatchScanner
+scanner = BatchScanner([Policy(POLICY)])
+status, detail, match = scanner.scan_statuses([pod(i) for i in range(4)])
+from kyverno_tpu.compiler import aot
+aot.flush_stores()
+C = 'kyverno_tpu_compile_cache_requests_total'
+print(json.dumps({
+    'miss': reg.counter_value(C, result='miss'),
+    'aot_load': reg.counter_value(C, result='aot_load'),
+    'aot_store': reg.counter_value(C, result='aot_store'),
+    'status': status.tolist(),
+    'detail': detail.tolist(),
+    'match': match.tolist(),
+}))
+'''
+
+
+def _run_fresh_process(cache_dir, aot_enabled=True, timeout=240):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+    env.update({
+        'JAX_PLATFORMS': 'cpu',
+        'PYTHONPATH': REPO,
+        'KTPU_AOT': '1' if aot_enabled else '0',
+        'KTPU_AOT_CACHE_DIR': os.path.join(str(cache_dir), 'aot'),
+        'KTPU_COMPILE_CACHE': os.path.join(str(cache_dir), 'xla'),
+    })
+    out = subprocess.run([sys.executable, '-c', _SECOND_PROC_SCRIPT],
+                         env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=timeout)
+    assert out.returncode == 0, out.stderr[-4000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_second_process_zero_fresh_compiles(tmp_path):
+    """ISSUE 2 acceptance: process 1 compiles + persists; process 2
+    (fresh interpreter, cold jit caches, same policy set) serves
+    entirely from aot_load with zero misses; a third process with the
+    cache disabled recompiles and produces bit-identical matrices."""
+    first = _run_fresh_process(tmp_path)
+    assert first['miss'] >= 1, first
+    assert first['aot_store'] >= 1, first
+    second = _run_fresh_process(tmp_path)
+    assert second['miss'] == 0, second
+    assert second['aot_load'] >= 1, second
+    uncached = _run_fresh_process(tmp_path, aot_enabled=False)
+    assert uncached['miss'] >= 1, uncached
+    for field in ('status', 'detail', 'match'):
+        assert second[field] == first[field] == uncached[field], field

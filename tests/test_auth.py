@@ -331,3 +331,26 @@ class TestCleanupAuth:
             assert resp['response']['allowed'] is True
         finally:
             server.stop()
+
+
+class TestPluralize:
+    """SSAR probes must target real GVRs: -ies only after a consonant,
+    irregulars from the table (the old rule produced 'gatewaies')."""
+
+    def test_consonant_y_takes_ies(self):
+        assert gvr_from_kind('NetworkPolicy')[1] == 'networkpolicies'
+        assert gvr_from_kind('Proxy')[1] == 'proxies'
+
+    def test_vowel_y_takes_plain_s(self):
+        assert gvr_from_kind('Gateway')[1] == 'gateways'
+        assert gvr_from_kind('gateway.networking.k8s.io/v1/Gateway') == \
+            ('gateway.networking.k8s.io', 'gateways')
+
+    def test_irregular_table(self):
+        assert gvr_from_kind('Endpoints')[1] == 'endpoints'
+        assert gvr_from_kind('PodMetrics')[1] == 'pods'
+        assert gvr_from_kind('ReferenceGrant')[1] == 'referencegrants'
+
+    def test_sibilant_suffixes(self):
+        assert gvr_from_kind('Ingress')[1] == 'ingresses'
+        assert gvr_from_kind('ConfigMap')[1] == 'configmaps'

@@ -79,3 +79,78 @@ def test_fast_rate_improvement(policies, monkeypatch):
     slow_s = time.time() - t0
     # the precompiled path must be dramatically faster on this pack
     assert fast_s * 3 < slow_s, (fast_s, slow_s)
+
+
+# ---------------------------------------------------------------------------
+# fast-path escape hatches: shapes where the engine's semantics diverge
+# from the compiled applier must FALLBACK (and stay bit-identical)
+
+def test_json6902_replace_on_missing_path_falls_back():
+    """`replace` must FALLBACK when the leaf or any intermediate is
+    absent — the engine FAILs with 'replace path not found'; only `add`
+    may create paths.  The old fast path silently PASSed and mutated."""
+    import json as _json
+    from kyverno_tpu.compiler.mutate_compile import (FALLBACK,
+                                                     compile_json6902)
+    from kyverno_tpu.engine.api import RuleStatus
+    patch = _json.dumps([{'op': 'replace',
+                          'path': '/metadata/labels/app',
+                          'value': 'patched'}])
+    fast = compile_json6902(patch)
+    assert fast is not None
+    # leaf absent
+    assert fast.apply({'metadata': {'labels': {}}}) is FALLBACK
+    # intermediate absent
+    assert fast.apply({'metadata': {}}) is FALLBACK
+    assert fast.apply({}) is FALLBACK
+    # present: replaces in place, engine-identical
+    status, _msg, changed, patched = fast.apply(
+        {'metadata': {'labels': {'app': 'old'}}})
+    assert status == RuleStatus.PASS and changed
+    assert patched['metadata']['labels']['app'] == 'patched'
+    # the engine really does FAIL on the shapes we defer
+    from kyverno_tpu.engine.mutate.mutate import _apply_json6902
+    resp = _apply_json6902(patch, {'metadata': {}})
+    assert resp.status == RuleStatus.FAIL
+    assert 'not found' in resp.message
+
+
+def test_json6902_add_still_creates_paths():
+    import json as _json
+    from kyverno_tpu.compiler.mutate_compile import compile_json6902
+    from kyverno_tpu.engine.api import RuleStatus
+    patch = _json.dumps([{'op': 'add', 'path': '/metadata/labels/app',
+                          'value': 'x'}])
+    fast = compile_json6902(patch)
+    status, _msg, changed, patched = fast.apply({'metadata': {}})
+    assert status == RuleStatus.PASS and changed
+    assert patched['metadata']['labels']['app'] == 'x'
+
+
+def test_foreach_duplicate_element_names_fall_back():
+    """Strategic merge coalesces duplicate-named list elements onto the
+    first occurrence; the fast path patches independently, so duplicate
+    names must take the engine path."""
+    from kyverno_tpu.compiler.mutate_compile import (FALLBACK,
+                                                     compile_foreach)
+    rule = {'name': 'set-pull-policy', 'mutate': {'foreach': [
+        {'list': 'request.object.spec.containers',
+         'patchStrategicMerge': {'spec': {'containers': [
+             {'name': '{{element.name}}',
+              'imagePullPolicy': 'IfNotPresent'}]}}}]}}
+    fast = compile_foreach(rule['mutate']['foreach'], rule)
+    assert fast is not None
+
+    def doc(names):
+        return {'apiVersion': 'v1', 'kind': 'Pod',
+                'metadata': {'name': 'p', 'namespace': 'd'},
+                'spec': {'containers': [
+                    {'name': n, 'image': 'i'} for n in names]}}
+    assert fast.apply(doc(['a', 'a'])) is FALLBACK
+    assert fast.apply(doc(['a', None])) is FALLBACK  # non-string name
+    out = fast.apply(doc(['a', 'b']))
+    assert out is not FALLBACK
+    _status, _msg, changed, patched = out
+    assert changed
+    assert all(c['imagePullPolicy'] == 'IfNotPresent'
+               for c in patched['spec']['containers'])
