@@ -874,12 +874,17 @@ def main() -> int:
     # device-pipeline telemetry: per-stage histograms feed the
     # stage_breakdown block of the JSON line; BENCH_TRACE_JSONL=<path>
     # additionally streams every stage span as OTLP-shaped JSON lines
+    from kyverno_tpu.observability import coverage as coverage_ledger
     from kyverno_tpu.observability import device as device_telemetry
     from kyverno_tpu.observability import tracing as _tracing
     jsonl_path = os.environ.get('BENCH_TRACE_JSONL', '')
     if jsonl_path:
         _tracing.configure(memory=False, jsonl_path=jsonl_path)
-    device_telemetry.configure()
+    reg = device_telemetry.configure()
+    # device-coverage ledger: the `coverage` block below tracks how much
+    # of the measured traffic actually ran on device (and why the rest
+    # fell back) alongside the latency numbers
+    coverage_ledger.configure(reg)
     # BENCH_CONFIG=4|5 runs the scaled BASELINE configs; default is the
     # north-star background scan
     config = os.environ.get('BENCH_CONFIG', '')
@@ -903,6 +908,20 @@ def main() -> int:
                 for r in ('hit', 'miss', 'aot_load', 'aot_store')}
             result['aot_store'] = dict(default_store().stats(),
                                        enabled=default_store().enabled)
+        cov = coverage_ledger.bench_block()
+        if cov is not None:
+            # ledger invariant: every evaluated row is attributed to
+            # exactly one side.  A mis-attributed fallback site (a host
+            # branch that forgot to record) fails the bench run here
+            # instead of silently skewing the coverage trajectory.
+            if cov['device_rows'] + cov['host_rows'] != cov['total_rows']:
+                raise AssertionError(
+                    'coverage ledger out of balance: '
+                    f"device_rows={cov['device_rows']} + "
+                    f"host_rows={cov['host_rows']} != "
+                    f"total_rows={cov['total_rows']} — a fallback site "
+                    'is unattributed')
+            result['coverage'] = cov
     except Exception as e:  # noqa: BLE001 - always emit a JSON line
         import traceback
         traceback.print_exc()

@@ -58,9 +58,13 @@ class Setup:
             # device-pipeline telemetry (stage histograms, compile-cache
             # counters, d2h stall watchdog — KTPU_D2H_STALL_S)
             from ..observability.metrics import set_global_registry
+            from ..observability import coverage
             from ..observability import device as device_telemetry
             set_global_registry(self.metrics)
             device_telemetry.configure(self.metrics)
+            # device-coverage ledger: per-rule placement + attributed
+            # host-fallback counters (GET /debug/coverage with --profile)
+            coverage.configure(self.metrics)
         self.configuration = Configuration()
         if client is None:
             from ..dclient.client import FakeClient

@@ -27,8 +27,53 @@ from ..api.unstructured import Resource
 from ..engine.api import PolicyContext
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
+from ..observability import coverage
 from .scan import _group_key, _rule_match_is_label_simple, \
     _rule_match_is_simple, policy_namespace_gate
+
+
+def mutate_placements(policies: List[Policy]) -> list:
+    """Per-(policy, rule) placement of the bulk-apply path, mirroring
+    BatchApplier's fast-path qualification: ``device`` = precompiled
+    fast applier (mutate_compile), ``host`` = engine loop, with the
+    attributed reason.  Generate rules are host-bound by design (they
+    emit UpdateRequest specs through the background pipeline)."""
+    import os as _os
+    from .mutate_compile import compile_mutate_rule
+    fast_enabled = _os.environ.get('KTPU_FAST_MUTATE', '1') == '1'
+    out = []
+    for i, p in enumerate(policies):
+        mutate_rules = [r for r in p.rules if r.has_mutate()]
+        compiled = {r.name: fast_enabled and
+                    compile_mutate_rule(r.raw, p.name) is not None
+                    for r in mutate_rules}
+        policy_ok = fast_enabled and bool(mutate_rules) and \
+            all(compiled.values()) and \
+            (p.apply_rules or 'All') != 'One' and not p.is_namespaced
+        for r in mutate_rules:
+            if policy_ok:
+                out.append(coverage.RulePlacement(
+                    p.name, r.name, 'mutate',
+                    coverage.PLACEMENT_DEVICE, None, '', i))
+            elif compiled.get(r.name):
+                out.append(coverage.RulePlacement(
+                    p.name, r.name, 'mutate', coverage.PLACEMENT_HOST,
+                    coverage.REASON_POLICY_COUPLING,
+                    'rule compiled but the policy leaves the fast path '
+                    '(sibling rule, applyRules=One, or namespaced)', i))
+            else:
+                out.append(coverage.RulePlacement(
+                    p.name, r.name, 'mutate', coverage.PLACEMENT_HOST,
+                    coverage.REASON_UNSUPPORTED_OPERATOR,
+                    'mutation shape outside the fast-applier '
+                    'vocabulary (mutate_compile.py)', i))
+        for r in p.rules:
+            if r.has_generate():
+                out.append(coverage.RulePlacement(
+                    p.name, r.name, 'generate', coverage.PLACEMENT_HOST,
+                    coverage.REASON_HOST_CLOSURE,
+                    'generate rules feed the UpdateRequest pipeline', i))
+    return out
 
 
 class ApplyResult:
@@ -103,7 +148,7 @@ class BatchApplier:
                 for rule in p.rules:
                     if not rule.has_mutate():
                         continue
-                    fast = compile_mutate_rule(rule.raw)
+                    fast = compile_mutate_rule(rule.raw, p.name)
                     if fast is None:
                         ok = False
                         break
@@ -111,6 +156,12 @@ class BatchApplier:
                 if ok and compiled and (p.apply_rules or 'All') != 'One' \
                         and not p.is_namespaced:
                     self._fast_mutate[pi] = compiled
+        if coverage.enabled():
+            # mutate/generate half of the coverage ledger (runtime
+            # FALLBACK escapes are attributed inside the appliers; note
+            # that process-pool applies count in the worker, so bulk
+            # parallel runs under-report on the parent's ledger)
+            coverage.record_placements(mutate_placements(self.policies))
 
     # -- match sieve --------------------------------------------------------
 

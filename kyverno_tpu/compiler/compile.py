@@ -24,6 +24,9 @@ from ..engine import anchor as anchor_mod
 from ..engine import pattern as leaf_pattern
 from ..engine.validate_pattern import has_nested_anchors
 from ..engine.variables import is_reference, is_variable
+from ..observability.coverage import (REASON_HOST_CLOSURE,
+                                      PLACEMENT_DEVICE, PLACEMENT_HOST,
+                                      RulePlacement)
 from ..utils.duration import parse_duration
 from ..utils.quantity import Quantity
 from .ir import (CMP_EQ, CMP_GE, CMP_GT, CMP_LE, CMP_LT, CMP_NE, STR_LEN,
@@ -48,7 +51,11 @@ def compile_policies(policies: List[Policy]) -> CompiledPolicySet:
     cps.policies = policies
     for p_idx, policy in enumerate(policies):
         for r_idx, rule in enumerate(compute_rules(policy)):
-            if not rule.get('validate'):
+            name = rule.get('name', '')
+            validate = rule.get('validate')
+            path = 'pss' if isinstance(validate, dict) and \
+                validate.get('podSecurity') is not None else 'validate'
+            if not validate:
                 # mutate/generate-only rules produce no validate responses
                 # in a background scan (engine.py:254-260 _process_rule);
                 # verifyImages validation stays host-side (network-bound)
@@ -56,13 +63,23 @@ def compile_policies(policies: List[Policy]) -> CompiledPolicySet:
                        iv.get('required', True)
                        for iv in rule.get('verifyImages') or []):
                     cps.host_rules.append((p_idx, rule, policy))
+                    cps.placements.append(RulePlacement(
+                        policy.name, name, path, PLACEMENT_HOST,
+                        REASON_HOST_CLOSURE,
+                        'verifyImages rules are network-bound', p_idx))
                 continue
             try:
                 program = _compile_rule(cps, policy, p_idx, r_idx, rule)
-            except CompileError:
+            except CompileError as e:
                 cps.host_rules.append((p_idx, rule, policy))
+                cps.placements.append(RulePlacement(
+                    policy.name, name, path, PLACEMENT_HOST, e.reason,
+                    str(e), p_idx))
                 continue
             cps.programs.append(program)
+            cps.placements.append(RulePlacement(
+                policy.name, name, path, PLACEMENT_DEVICE, None, '',
+                p_idx))
     return cps
 
 
@@ -87,7 +104,7 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
                     e.get('variable')):
                 raise CompileError(
                     'imageRegistry context entries require the host '
-                    'engine')
+                    'engine', reason='api_call')
         body = json.dumps({'v': validate,
                            'p': rule.get('preconditions')})
         for entry in entries:
@@ -114,7 +131,8 @@ def _compile_rule(cps: CompiledPolicySet, policy: Policy, p_idx: int,
                 exprs.append(expr)
         context_inputs = tuple(sorted(set(exprs))) if cacheable else None
     if validate.get('manifests') is not None:
-        raise CompileError('manifests rules require the host engine')
+        raise CompileError('manifests rules require the host engine',
+                           reason='host_closure')
     if not isinstance(rule.get('match', {}) or {}, dict) or \
             not isinstance(rule.get('exclude', {}) or {}, dict):
         raise CompileError('bad match/exclude block')

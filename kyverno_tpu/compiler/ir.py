@@ -400,6 +400,10 @@ class CompiledPolicySet:
     # (policy_index, rule dict, policy) for rules the device cannot evaluate
     host_rules: List[Tuple[int, dict, Any]] = field(default_factory=list)
     policies: List[Any] = field(default_factory=list)
+    # per-(policy, rule) device/host placement with the attributed
+    # fallback reason (observability/coverage.py RulePlacement), in
+    # compile order — the compile-time half of the coverage ledger
+    placements: List[Any] = field(default_factory=list)
 
     def slot_id(self, slot: Slot) -> int:
         if slot not in self.slot_index:
@@ -421,4 +425,14 @@ class CompiledPolicySet:
 
 
 class CompileError(Exception):
-    """Raised when a rule (or part) cannot be vectorized → host fallback."""
+    """Raised when a rule (or part) cannot be vectorized → host fallback.
+
+    ``reason`` is a stable taxonomy slug (observability/coverage.py
+    REASONS) recording WHY the rule left the device path; the default
+    covers the common case of an operator / pattern shape outside the
+    device vocabulary."""
+
+    def __init__(self, message: str = '',
+                 reason: str = 'unsupported_operator'):
+        super().__init__(message)
+        self.reason = reason

@@ -32,9 +32,24 @@ from ..engine.api import RuleStatus
 from ..engine.jmespath import compile as jp_compile
 from ..engine.mutate.mutate import _success_message
 from ..engine.variables import RE_VARIABLE_INIT, tree_has_variables
+from ..observability import coverage
+from ..observability.coverage import (REASON_DUP_ELEMENT_NAMES,
+                                      REASON_NON_DICT,
+                                      REASON_PRECONDITION_ESCAPE,
+                                      REASON_REPLACE_PATH_MISSING)
 
 #: sentinel: this resource's shape left the compiled fast path
 FALLBACK = object()
+
+
+def _fallback(reason: str, rule_name: str = '', policy_name: str = ''):
+    """Record one attributed fast-path escape on the coverage ledger
+    (``kyverno_tpu_host_fallback_total{path="mutate", reason=...}``; a
+    no-op until coverage.configure) and return the shared FALLBACK
+    sentinel — callers and tests compare by identity."""
+    coverage.record_fallback('mutate', reason, policy=policy_name,
+                             rule=rule_name)
+    return FALLBACK
 
 _ADD_ANCHOR_RE = re.compile(r'^\+\((.+)\)$')
 
@@ -144,7 +159,9 @@ def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
     return True, patched
 
 
-def compile_strategic_merge(overlay: Any) -> Optional[CompiledMutation]:
+def compile_strategic_merge(overlay: Any, rule_name: str = '',
+                            policy_name: str = ''
+                            ) -> Optional[CompiledMutation]:
     sets = _compile_overlay(overlay)
     if sets is None:
         return None
@@ -152,7 +169,7 @@ def compile_strategic_merge(overlay: Any) -> Optional[CompiledMutation]:
     def apply(doc: dict):
         result = _apply_sets(doc, sets)
         if result is FALLBACK:
-            return FALLBACK
+            return _fallback(REASON_NON_DICT, rule_name, policy_name)
         changed, patched = result
         if not changed:
             return (RuleStatus.SKIP, 'no patches applied', False, doc)
@@ -163,7 +180,8 @@ def compile_strategic_merge(overlay: Any) -> Optional[CompiledMutation]:
 
 # -- static json6902 --------------------------------------------------------
 
-def compile_json6902(patch_text: Any) -> Optional[CompiledMutation]:
+def compile_json6902(patch_text: Any, rule_name: str = '',
+                     policy_name: str = '') -> Optional[CompiledMutation]:
     from ..engine.mutate.mutate import _load_patches_cached
     if not isinstance(patch_text, str) or '{{' in patch_text:
         return None
@@ -197,11 +215,12 @@ def compile_json6902(patch_text: Any) -> Optional[CompiledMutation]:
             cur: Any = doc
             for part in parts:
                 if not isinstance(cur, dict) or part not in cur:
-                    return FALLBACK
+                    return _fallback(REASON_REPLACE_PATH_MISSING,
+                                     rule_name, policy_name)
                 cur = cur[part]
         result = _apply_sets(doc, sets)
         if result is FALLBACK:
-            return FALLBACK
+            return _fallback(REASON_NON_DICT, rule_name, policy_name)
         changed, patched = result
         if not changed:
             return (RuleStatus.SKIP, 'no patches applied', False, doc)
@@ -278,9 +297,11 @@ def _compile_element_conditions(conditions: Any) -> Optional[Callable]:
     return evaluate
 
 
-def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]:
+def compile_foreach(foreach_list: Any, rule: dict,
+                    policy_name: str = '') -> Optional[CompiledMutation]:
     """Single-entry foreach over a list of named maps with an inner
     merge-by-name overlay (the imagePullPolicy shape)."""
+    rule_name = str(rule.get('name', ''))
     if rule.get('preconditions') is not None or \
             not isinstance(foreach_list, list) or len(foreach_list) != 1:
         return None
@@ -321,11 +342,11 @@ def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]
         cur: Any = doc
         for part in list_path:
             if not isinstance(cur, dict):
-                return FALLBACK
+                return _fallback(REASON_NON_DICT, rule_name, policy_name)
             cur = cur.get(part)
         if not isinstance(cur, list) or \
                 not all(isinstance(e, dict) for e in cur):
-            return FALLBACK
+            return _fallback(REASON_NON_DICT, rule_name, policy_name)
         # the engine's strategic merge matches overlay entries to list
         # elements BY NAME and coalesces duplicates onto the first
         # occurrence; the fast path patches elements independently, so
@@ -333,17 +354,19 @@ def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]
         names = [e.get('name') for e in cur]
         if any(not isinstance(n, str) for n in names) or \
                 len(set(names)) != len(names):
-            return FALLBACK
+            return _fallback(REASON_DUP_ELEMENT_NAMES, rule_name,
+                             policy_name)
         new_list = None
         for i, element in enumerate(cur):
             passed = cond_eval(element)
             if passed is None:
-                return FALLBACK
+                return _fallback(REASON_PRECONDITION_ESCAPE, rule_name,
+                                 policy_name)
             if not passed:
                 continue
             result = _apply_sets(element, elem_sets)
             if result is FALLBACK:
-                return FALLBACK
+                return _fallback(REASON_NON_DICT, rule_name, policy_name)
             changed, patched_elem = result
             if changed:
                 if new_list is None:
@@ -365,19 +388,25 @@ def compile_foreach(foreach_list: Any, rule: dict) -> Optional[CompiledMutation]
     return CompiledMutation(apply)
 
 
-def compile_mutate_rule(rule: dict) -> Optional[CompiledMutation]:
-    """Fast applier for one mutate rule, or None → engine loop."""
+def compile_mutate_rule(rule: dict,
+                        policy_name: str = '') -> Optional[CompiledMutation]:
+    """Fast applier for one mutate rule, or None → engine loop.
+    ``policy_name`` labels the applier's runtime FALLBACK attribution
+    on the coverage ledger."""
     if rule.get('context') or rule.get('preconditions') is not None:
         return None
     mutation = rule.get('mutate') or {}
     if mutation.get('targets'):
         return None
+    rule_name = str(rule.get('name', ''))
     if mutation.get('foreach') is not None:
-        return compile_foreach(mutation['foreach'], rule)
+        return compile_foreach(mutation['foreach'], rule, policy_name)
     if mutation.get('patchStrategicMerge') is not None:
         if mutation.get('patchesJson6902'):
             return None
-        return compile_strategic_merge(mutation['patchStrategicMerge'])
+        return compile_strategic_merge(mutation['patchStrategicMerge'],
+                                       rule_name, policy_name)
     if mutation.get('patchesJson6902'):
-        return compile_json6902(mutation['patchesJson6902'])
+        return compile_json6902(mutation['patchesJson6902'], rule_name,
+                                policy_name)
     return None

@@ -31,6 +31,7 @@ from ..engine.api import (EngineResponse, PolicyContext, RuleResponse,
                           RuleStatus, RuleType)
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
+from ..observability import coverage
 from .compile import compile_policies
 from .encode import encode_batch
 from .ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
@@ -187,6 +188,18 @@ class BatchScanner:
         self._dev_mask = np.zeros(len(self.cps.programs), bool)
         for _j, _ in self.device_programs:
             self._dev_mask[_j] = True
+        # final per-rule placement (compile placements + the policy-
+        # coupling override above); feeds the coverage ledger and the
+        # host-run fallback attribution below
+        self._placements = coverage.compile_placements(policies, self.cps)
+        self._host_rule_reason = {
+            (pl.policy, pl.rule): (pl.reason or
+                                   coverage.REASON_POLICY_COUPLING,
+                                   pl.path)
+            for pl in self._placements
+            if pl.placement == coverage.PLACEMENT_HOST}
+        if coverage.enabled():
+            coverage.record_placements(self._placements)
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
         from collections import OrderedDict
@@ -613,29 +626,49 @@ class BatchScanner:
         # error when the consumer stops iterating early
         from ..observability import tracing
         chunks = self._device_status_chunks(resources, contexts, match)
+        tally = coverage.scan_tally()
         start = 0
-        while start < n:
-            with tracing.start_span(
-                    'kyverno/device/scan',
-                    {'chunk_start': start,
-                     'programs': len(progs)}) as span:
-                try:
-                    start, status, detail, fdet = next(chunks)
-                except StopIteration:
-                    return
-                span.set_attribute('resources', status.shape[0])
-                from ..observability import device as devtel
-                with devtel.stage('report', {'rows': status.shape[0]}):
-                    chunk_rows = self._assemble_chunk(
-                        resources, wrapped, match, start, status, detail,
-                        fdet, now, ts, background_mode, background_ok,
-                        host_maybe)
-            start += status.shape[0]
-            yield from chunk_rows
+        try:
+            while start < n:
+                with tracing.start_span(
+                        'kyverno/device/scan',
+                        {'chunk_start': start,
+                         'programs': len(progs)}) as span:
+                    try:
+                        start, status, detail, fdet = next(chunks)
+                    except StopIteration:
+                        return
+                    span.set_attribute('resources', status.shape[0])
+                    from ..observability import device as devtel
+                    with devtel.stage('report',
+                                      {'rows': status.shape[0]}) as rstage:
+                        chunk_rows = self._assemble_chunk(
+                            resources, wrapped, match, start, status,
+                            detail, fdet, now, ts, background_mode,
+                            background_ok, host_maybe, tally)
+                        if tally is not None:
+                            ratio = tally.ratio()
+                            if ratio is not None:
+                                # cumulative within this scan — the
+                                # fallback-attribution view of the chunk
+                                rstage.set_attribute(
+                                    'device_coverage_ratio',
+                                    round(ratio, 4))
+                                span.set_attribute(
+                                    'device_coverage_ratio',
+                                    round(ratio, 4))
+                start += status.shape[0]
+                yield from chunk_rows
+        finally:
+            # flush even when the consumer abandons the stream early —
+            # partial scans still land in the ledger and set the
+            # per-scan coverage-ratio gauge
+            if tally is not None:
+                tally.finish()
 
     def _assemble_chunk(self, resources, wrapped, match, start, status,
                         detail, fdet, now, ts, background_mode,
-                        background_ok, host_maybe
+                        background_ok, host_maybe, tally=None
                         ) -> List[List[EngineResponse]]:
         """Assemble one device chunk into per-resource engine responses.
 
@@ -666,7 +699,7 @@ class BatchScanner:
                         continue
                     rr = self._cell(prog, j, int(st_row[j]),
                                     int(det_row[j]), fdet[k], ts, fly,
-                                    resources[start + k])
+                                    resources[start + k], tally)
                     if rr is _HOST:
                         rr = self._materialize(prog,
                                                resources[start + k])
@@ -691,7 +724,7 @@ class BatchScanner:
                 det_col = detail[rows, j].tolist()
                 for k, st, det in zip(rows.tolist(), st_col, det_col):
                     rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
-                                    resources[start + k])
+                                    resources[start + k], tally)
                     if rr is _HOST:
                         # anchor-SKIP / HOST / unsynthesizable FAIL:
                         # re-run on the host for exact status+message
@@ -730,11 +763,25 @@ class BatchScanner:
                         p_idx, res_doc, now, wrapped[i])
                 elif host_maybe[p_idx] is None or host_maybe[p_idx][i]:
                     responses[p_idx] = self._host_run(p_idx, res_doc)
+                    if tally is not None:
+                        self._tally_host_policy(tally, p_idx,
+                                                responses[p_idx])
                 else:
                     responses[p_idx] = self._new_response(
                         p_idx, res_doc, now, wrapped[i])
             chunk_rows.append([responses[q] for q in sorted(responses)])
         return chunk_rows
+
+    def _tally_host_policy(self, tally, p_idx: int, resp) -> None:
+        """Attribute every rule response of a whole-policy host run to
+        its compile-time fallback reason (policy_coupling for rules that
+        compiled but ride host with their policy)."""
+        pol = self._policy_header[p_idx][1]
+        for rr in resp.policy_response.rules:
+            reason, path = self._host_rule_reason.get(
+                (pol, rr.name),
+                (coverage.REASON_POLICY_COUPLING, 'validate'))
+            tally.host_rule(pol, rr.name, reason, path)
 
     def scan_report_results(self, resources: List[dict],
                             now: Optional[float] = None):
@@ -786,83 +833,107 @@ class BatchScanner:
             return result, sort_key
 
         chunks = self._device_status_chunks(resources, None, match)
+        tally = coverage.scan_tally()
         start = 0
-        while start < n:
-            try:
-                start, status, detail, fdet = next(chunks)
-            except StopIteration:
-                return
-            m = status.shape[0]
-            sub_match = match[start:start + m]
-            fly: Dict[Tuple, Any] = {}
-            rows: List[list] = [[] for _ in range(m)]
-            row_policies: List[set] = [set() for _ in range(m)]
-            from ..observability import device as devtel
-            with devtel.stage('report', {'rows': m}):
-                for j, prog in self.device_programs:
-                    if not background_ok[j]:
-                        continue
-                    rows_j = np.flatnonzero(sub_match[:, j])
-                    if rows_j.size == 0:
-                        continue
-                    p_idx = prog.policy_index
-                    st_col = status[rows_j, j].tolist()
-                    det_col = detail[rows_j, j].tolist()
-                    for k, st, det in zip(rows_j.tolist(), st_col, det_col):
-                        rr = self._cell(prog, j, st, det, fdet[k], ts, fly,
-                                        resources[start + k])
-                        if rr is _HOST_MARKER:
-                            rr = self._materialize(prog,
-                                                   resources[start + k])
-                            if rr is not None:
-                                rr.timestamp = ts
-                        if rr is None:
+        try:
+            while start < n:
+                try:
+                    start, status, detail, fdet = next(chunks)
+                except StopIteration:
+                    return
+                m = status.shape[0]
+                sub_match = match[start:start + m]
+                fly: Dict[Tuple, Any] = {}
+                rows: List[list] = [[] for _ in range(m)]
+                row_policies: List[set] = [set() for _ in range(m)]
+                from ..observability import device as devtel
+                with devtel.stage('report', {'rows': m}) as rstage:
+                    for j, prog in self.device_programs:
+                        if not background_ok[j]:
                             continue
-                        result, sort_key = to_result(rr, p_idx)
-                        rows[k].append((sort_key, result))
+                        rows_j = np.flatnonzero(sub_match[:, j])
+                        if rows_j.size == 0:
+                            continue
+                        p_idx = prog.policy_index
+                        st_col = status[rows_j, j].tolist()
+                        det_col = detail[rows_j, j].tolist()
+                        for k, st, det in zip(rows_j.tolist(), st_col,
+                                              det_col):
+                            rr = self._cell(prog, j, st, det, fdet[k],
+                                            ts, fly, resources[start + k],
+                                            tally)
+                            if rr is _HOST_MARKER:
+                                rr = self._materialize(
+                                    prog, resources[start + k])
+                                if rr is not None:
+                                    rr.timestamp = ts
+                            if rr is None:
+                                continue
+                            result, sort_key = to_result(rr, p_idx)
+                            rows[k].append((sort_key, result))
+                            row_policies[k].add(p_idx)
+                    if tally is not None:
+                        ratio = tally.ratio()
+                        if ratio is not None:
+                            rstage.set_attribute('device_coverage_ratio',
+                                                 round(ratio, 4))
+                for k in range(m):
+                    i = start + k
+                    res_doc = resources[i]
+                    entries = rows[k]
+                    for p_idx in self._host_policy_idx:
+                        if not self._policy_header[p_idx][0].background:
+                            continue
+                        if host_maybe[p_idx] is not None and \
+                                not host_maybe[p_idx][i]:
+                            continue
+                        resp = self._host_run(p_idx, res_doc)
+                        if tally is not None:
+                            self._tally_host_policy(tally, p_idx, resp)
+                        if not resp.policy_response.rules:
+                            continue
                         row_policies[k].add(p_idx)
-            for k in range(m):
-                i = start + k
-                res_doc = resources[i]
-                entries = rows[k]
-                for p_idx in self._host_policy_idx:
-                    if not self._policy_header[p_idx][0].background:
-                        continue
-                    if host_maybe[p_idx] is not None and \
-                            not host_maybe[p_idx][i]:
-                        continue
-                    resp = self._host_run(p_idx, res_doc)
-                    if not resp.policy_response.rules:
-                        continue
-                    row_policies[k].add(p_idx)
-                    for result in engine_response_to_report_results(
-                            resp, now=ts):
-                        entries.append((
-                            (result.get('policy', ''),
-                             result.get('rule', ''), 0, (), str(ts)),
-                            result))
-                entries.sort(key=lambda e: e[0])
-                results = [r for _sk, r in entries]
-                summary = calculate_summary(results)
-                yield (results, summary,
-                       [self.policies[p] for p in sorted(row_policies[k])])
-            start += m
+                        for result in engine_response_to_report_results(
+                                resp, now=ts):
+                            entries.append((
+                                (result.get('policy', ''),
+                                 result.get('rule', ''), 0, (), str(ts)),
+                                result))
+                    entries.sort(key=lambda e: e[0])
+                    results = [r for _sk, r in entries]
+                    summary = calculate_summary(results)
+                    yield (results, summary,
+                           [self.policies[p]
+                            for p in sorted(row_policies[k])])
+                start += m
+        finally:
+            if tally is not None:
+                tally.finish()
 
     def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
-              fly: Dict[Tuple, Any], resource: Optional[dict] = None):
+              fly: Dict[Tuple, Any], resource: Optional[dict] = None,
+              tally=None):
         """Flyweight RuleResponse for one device cell (or _HOST_MARKER).
 
         FAIL cells key on the synthesized message — the fail-site detail
         row carries anyPattern metadata beyond column j and
         ``_fail_message_cached`` is itself memoized on the relevant
-        columns."""
+        columns.  ``tally`` (coverage.ScanTally or None) attributes
+        every host decision: each branch that returns _HOST_MARKER must
+        name its reason, so no fallback is ever silent."""
+        if tally is not None:
+            tally.total_rows += 1
         if prog.context_spec is not None and resource is not None and \
                 not self._context_ok(prog, resource):
             # load failure must surface the host's exact error response
+            if tally is not None:
+                tally.fallback(prog, coverage.REASON_CONTEXT_LOAD)
             return _HOST_MARKER
         if st == STATUS_FAIL:
             msg = self._fail_message_cached(prog, j, fdet_row)
             if msg is None:
+                if tally is not None:
+                    tally.fallback(prog, coverage.REASON_UNSYNTHESIZABLE)
                 return _HOST_MARKER
             key = (j, STATUS_FAIL, msg)
             rr = fly.get(key)
@@ -871,12 +942,22 @@ class BatchScanner:
                                   msg, RuleStatus.FAIL)
                 rr.timestamp = ts
                 fly[key] = rr
+            if tally is not None:
+                tally.device(prog)
             return rr
         key = (j, st, det)
         rr = fly.get(key)
         if rr is None:
             rr = self._synth_rule(prog, st, det, ts)
             fly[key] = rr
+        if tally is not None:
+            if rr is _HOST_MARKER:
+                tally.fallback(
+                    prog, coverage.REASON_STATUS_HOST
+                    if st == STATUS_HOST
+                    else coverage.REASON_UNSYNTHESIZABLE)
+            else:
+                tally.device(prog)
         return rr
 
     def _synth_rule(self, prog, st: int, det: int, ts: int):

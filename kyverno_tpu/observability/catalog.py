@@ -49,6 +49,17 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_d2h_stalls_total': Metric(
         'counter', 'Readbacks exceeding the stall watchdog threshold '
         '(KTPU_D2H_STALL_S, default 30s).'),
+    # device-coverage ledger (observability/coverage.py)
+    'kyverno_tpu_rule_placement_info': Metric(
+        'gauge', '1 per compiled (policy, rule, path); placement=device|'
+        'host|partial with the fallback-reason taxonomy slug.'),
+    'kyverno_tpu_host_fallback_total': Metric(
+        'counter', 'Rows served by the host engine instead of the '
+        'device/fast path, by path=validate|mutate|pss and attributed '
+        'reason (observability/coverage.py REASONS).'),
+    'kyverno_tpu_device_coverage_ratio': Metric(
+        'gauge', 'Device-decided fraction of the most recent scan '
+        '(device_rows / total_rows).'),
     # AOT cache + warm-up instruments (aotcache/)
     'kyverno_tpu_aot_warm_duration_seconds': Metric(
         'histogram', 'Background warm-up wall time by target/state '

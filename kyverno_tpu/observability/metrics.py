@@ -134,14 +134,17 @@ class MetricsRegistry:
         out: List[str] = []
         with self._lock:
             for name in sorted(self._counters):
+                _append_help(out, name)
                 out.append(f'# TYPE {name} counter')
                 for key, value in sorted(self._counters[name].items()):
                     out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
             for name in sorted(self._gauges):
+                _append_help(out, name)
                 out.append(f'# TYPE {name} gauge')
                 for key, value in sorted(self._gauges[name].items()):
                     out.append(f'{name}{_fmt_labels(key)} {_fmt(value)}')
             for name in sorted(self._hists):
+                _append_help(out, name)
                 out.append(f'# TYPE {name} histogram')
                 bounds = self._buckets.get(name, _DEFAULT_BUCKETS)
                 for key, (count, total, buckets) in sorted(
@@ -157,6 +160,15 @@ class MetricsRegistry:
                                f'{_fmt(total)}')
                     out.append(f'{name}_count{_fmt_labels(key)} {count}')
         return '\n'.join(out) + '\n'
+
+
+def _append_help(out: List[str], name: str) -> None:
+    """# HELP line from the metric catalog (every exported name is
+    cataloged — enforced by scripts/check_metric_names.py)."""
+    from .catalog import METRICS
+    metric = METRICS.get(name)
+    if metric is not None:
+        out.append(f'# HELP {name} {metric.help}')
 
 
 def _fmt(v: float) -> str:

@@ -11,6 +11,9 @@ Python equivalent of Go's net/http/pprof surface:
   stacks (``frame;frame;frame count`` lines — flamegraph-ready)
 * ``/debug/traces`` — recent spans from the in-memory trace exporter as
   OTLP-shaped JSON
+* ``/debug/coverage`` — the device-coverage ledger (per-rule placement,
+  attributed host-fallback counts) as JSON
+* ``/metrics`` — Prometheus text exposition of the active registry
 """
 
 from __future__ import annotations
@@ -88,7 +91,7 @@ class ProfilingServer:
                 parsed = urlparse(self.path)
                 if parsed.path in ('/debug/pprof', '/debug/pprof/'):
                     self._send('profiles:\n  goroutine\n  profile\n'
-                               '  traces\n')
+                               '  traces\n  coverage\n')
                 elif parsed.path == '/debug/pprof/goroutine':
                     self._send(thread_stacks())
                 elif parsed.path == '/debug/pprof/profile':
@@ -107,6 +110,12 @@ class ProfilingServer:
                         if mem is not None else []
                     self._send(json.dumps({'spans': spans}),
                                'application/json')
+                elif parsed.path == '/debug/coverage':
+                    from . import coverage
+                    led = coverage.ledger()
+                    body = dict(led.report(), enabled=True) \
+                        if led is not None else {'enabled': False}
+                    self._send(json.dumps(body), 'application/json')
                 elif parsed.path == '/metrics':
                     from . import device
                     from .metrics import global_registry

@@ -1747,7 +1747,8 @@ def build_evaluator(cps: CompiledPolicySet):
                 compiled = None
             if compiled is not None:
                 try:
-                    with devtel.stage('device_eval'):
+                    with devtel.stage('device_eval') as st:
+                        _stamp_coverage(st)
                         return compiled(packed)
                 except Exception:  # noqa: BLE001 - a deserialized
                     # executable can fail at EXECUTE time (e.g. machine-
@@ -1772,7 +1773,8 @@ def build_evaluator(cps: CompiledPolicySet):
                             st.set_attribute('cache', 'miss')
                             return jitted(packed)
                     devtel.record_cache('hit')
-                with devtel.stage('device_eval'):
+                with devtel.stage('device_eval') as st:
+                    _stamp_coverage(st)
                     return jitted(packed)
 
     call.jitted = jitted
@@ -1790,6 +1792,17 @@ def build_evaluator(cps: CompiledPolicySet):
     call.expand_identity = expand_identity
     call.uniq_groups = uniq_groups
     return call
+
+
+def _stamp_coverage(st) -> None:
+    """Attribute the device-coverage ratio of the most recently
+    completed scan onto a device_eval stage span (the assembly that
+    decides THIS dispatch's ratio runs after it; the ledger's last
+    ratio is the freshest attributable value)."""
+    from ..observability import coverage
+    ratio = coverage.last_ratio()
+    if ratio is not None:
+        st.set_attribute('device_coverage_ratio', round(ratio, 4))
 
 
 def fold_match_unique(mm: np.ndarray, evaluator) -> np.ndarray:

@@ -157,4 +157,14 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
         # psum'd summary is already replicated on every device)
         from jax.experimental import multihost_utils
         statuses = multihost_utils.process_allgather(statuses, tiled=True)
-    return np.asarray(statuses)[:n], np.asarray(summary)
+    statuses_np = np.asarray(statuses)[:n]
+    summary_np = np.asarray(summary)
+    from ..observability import coverage
+    if coverage.enabled():
+        # the padded rows are already masked out of the summary, so the
+        # STATUS_HOST column IS the host-replay row count of this step
+        from ..compiler.ir import STATUS_HOST
+        total = int(summary_np.sum())
+        host = int(summary_np[:, STATUS_HOST].sum())
+        coverage.record_scan(total - host, host)
+    return statuses_np, summary_np

@@ -29,12 +29,24 @@ PACKAGE = os.path.join(REPO_ROOT, 'kyverno_tpu')
 CATALOG_PATH = os.path.join(PACKAGE, 'observability', 'catalog.py')
 
 
+#: catalog entries with no write site in the tree that are legitimately
+#: alive — the ONLY names the dead-metric pass may skip, each with the
+#: reason it is allowed to exist without an emitter
+DEAD_METRIC_ALLOWLIST = {
+    'kyverno_client_queries_total':
+        'reserved for a real cluster client transport (dclient '
+        'interface exists; the in-memory fake does not emit queries)',
+}
+
+
 def _iter_sources() -> List[str]:
     out = []
-    for base, _dirs, files in os.walk(PACKAGE):
-        for name in files:
-            if name.endswith('.py'):
-                out.append(os.path.join(base, name))
+    # scripts/ is walked too: tooling must not emit uncataloged series
+    for root in (PACKAGE, os.path.join(REPO_ROOT, 'scripts')):
+        for base, _dirs, files in os.walk(root):
+            for name in files:
+                if name.endswith('.py'):
+                    out.append(os.path.join(base, name))
     out.append(os.path.join(REPO_ROOT, 'bench.py'))
     return sorted(p for p in out if os.path.exists(p))
 
@@ -111,6 +123,7 @@ def main() -> int:
             errors.append(f'catalog: {name} has invalid type {mtype!r}')
         if not mhelp.strip():
             errors.append(f'catalog: {name} has empty help text')
+    used = {name for _r, _l, name in resolved}
     for rel, line, name in resolved:
         if name not in catalog:
             errors.append(
@@ -120,13 +133,20 @@ def main() -> int:
         errors.append(
             f'{rel}:{line}: metric name is not a literal or module '
             f'constant ({desc}) — uncheckable, use a constant')
+    # dead-metric pass: a cataloged name with no write site anywhere in
+    # the tree is fiction — dashboards read a series that never exists
+    for name in catalog:
+        if name not in used and name not in DEAD_METRIC_ALLOWLIST:
+            errors.append(
+                f'catalog: {name} has no write site in the tree — '
+                f'remove the entry, add the emitter, or allowlist it '
+                f'with a reason (DEAD_METRIC_ALLOWLIST)')
     if not resolved:
         errors.append('no metric call sites found — checker is broken')
     if errors:
         for e in errors:
             print(e, file=sys.stderr)
         return 1
-    used = {name for _r, _l, name in resolved}
     print(f'ok: {len(resolved)} call sites over {len(used)} metrics, '
           f'{len(catalog)} cataloged')
     return 0

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Per-rule device-placement report for a policy set.
+
+Answers "which rules actually run on device, and why not the rest?"
+without scraping metrics.  Two modes:
+
+  scripts/coverage_report.py policy.yaml dir-of-policies/ ...
+      compile the packs locally and print each rule's placement
+      (device | host) with the attributed fallback reason — the same
+      ``coverage.compile_placements`` the live scanner records, so this
+      output and a running process's ``GET /debug/coverage`` can never
+      disagree on placement.
+
+  scripts/coverage_report.py --url http://127.0.0.1:6060
+      fetch the live ledger from a --profile process (placements plus
+      runtime device/host row counts and the fallback counters).
+
+``--json`` prints the machine-readable document instead of the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def load_policies(paths: List[str]):
+    import yaml
+    from kyverno_tpu.api.policy import Policy
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(
+                os.path.join(path, f) for f in sorted(os.listdir(path))
+                if f.endswith(('.yaml', '.yml')))
+        else:
+            files.append(path)
+    policies = []
+    for f in files:
+        with open(f, encoding='utf-8') as fh:
+            for doc in yaml.safe_load_all(fh):
+                if doc and doc.get('kind') in ('ClusterPolicy', 'Policy'):
+                    policies.append(Policy(doc))
+    return policies
+
+
+def compile_report(policies) -> dict:
+    """Compile-time half of the /debug/coverage document: validate/pss
+    placements from the policy compiler plus mutate/generate placements
+    from the bulk-apply fast-path qualifier."""
+    from kyverno_tpu.compiler.apply import mutate_placements
+    from kyverno_tpu.compiler.compile import compile_policies
+    from kyverno_tpu.observability import coverage
+    cps = compile_policies(policies)
+    placements = coverage.compile_placements(policies, cps)
+    placements += mutate_placements(policies)
+    rules = [{
+        'policy': p.policy, 'rule': p.rule, 'path': p.path,
+        'placement': p.placement, 'reason': p.reason, 'detail': p.detail,
+    } for p in placements]
+    totals = {'device': 0, 'host': 0}
+    for r in rules:
+        totals[r['placement']] = totals.get(r['placement'], 0) + 1
+    return {'rules': rules, 'totals': totals,
+            'n_policies': len(policies)}
+
+
+def fetch_report(url: str) -> dict:
+    from urllib.request import urlopen
+    with urlopen(url.rstrip('/') + '/debug/coverage', timeout=10) as resp:
+        return json.loads(resp.read().decode('utf-8'))
+
+
+def print_table(report: dict) -> None:
+    rules = report.get('rules', [])
+    if not rules:
+        print('no rules (empty policy set or ledger not configured)')
+        return
+    widths = (
+        max((len(r['policy']) for r in rules), default=6),
+        max((len(r['rule']) for r in rules), default=4),
+    )
+    header = (f'{"POLICY":<{widths[0]}}  {"RULE":<{widths[1]}}  '
+              f'{"PATH":<8}  {"PLACEMENT":<9}  REASON')
+    print(header)
+    print('-' * len(header))
+    for r in rules:
+        reason = r.get('reason') or ''
+        eff = r.get('effective')
+        placement = r['placement'] if not eff or eff == r['placement'] \
+            else f"{r['placement']}→{eff}"
+        line = (f'{r["policy"]:<{widths[0]}}  {r["rule"]:<{widths[1]}}  '
+                f'{r["path"]:<8}  {placement:<9}  {reason}')
+        if r.get('host_rows') or r.get('device_rows'):
+            line += (f'  [device_rows={r.get("device_rows", 0)} '
+                     f'host_rows={r.get("host_rows", 0)}]')
+        print(line)
+    totals = report.get('totals') or {}
+    if totals:
+        print('-' * len(header))
+        print('totals: ' + ', '.join(f'{k}={v}'
+                                     for k, v in sorted(totals.items())
+                                     if v is not None))
+    fallbacks = report.get('fallbacks') or {}
+    for path in sorted(fallbacks):
+        counts = ', '.join(f'{reason}={rows}'
+                           for reason, rows in
+                           sorted(fallbacks[path].items()))
+        print(f'fallbacks[{path}]: {counts}')
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='coverage_report',
+        description='per-rule device-placement report')
+    parser.add_argument('paths', nargs='*',
+                        help='policy YAML files or directories')
+    parser.add_argument('--url', default='',
+                        help='fetch /debug/coverage from a live '
+                             '--profile process instead of compiling')
+    parser.add_argument('--json', action='store_true', dest='as_json',
+                        help='print the JSON document')
+    args = parser.parse_args(argv)
+    if args.url:
+        report = fetch_report(args.url)
+    elif args.paths:
+        policies = load_policies(args.paths)
+        if not policies:
+            print('no policies found', file=sys.stderr)
+            return 1
+        report = compile_report(policies)
+    else:
+        parser.print_usage(sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print_table(report)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
