@@ -1,0 +1,37 @@
+"""ktpu-lint: AST-based static analysis for fast-path trace-safety,
+retrace hazards, and taxonomy/catalog drift.
+
+The failure modes that silently break "compiled once, served from TPU,
+bit-identical output" are program-structure properties, not runtime
+bugs: a host sync inside a jit'd region, a retrace storm from an
+unhashable closure, a fallback site that drifted out of the coverage
+taxonomy.  pytest never sees them; this package enforces them on every
+commit (``scripts/analyze.py``, wired into tier-1 by
+``tests/test_static_analysis.py``).
+
+Layout:
+
+* :mod:`.core` — finding model, rule registry (stable ``KTPU###`` ids),
+  per-line ``# ktpu: noqa[RULEID] -- reason`` suppressions, committed
+  baseline for grandfathered findings, and the :class:`Analyzer` driver
+* :mod:`.jitgraph` — import/def indexing and the jit-entry call graph
+  shared by the trace-safety and retrace passes
+* :mod:`.trace_safety` — KTPU101/102/103 (host syncs inside jit regions)
+* :mod:`.retrace` — KTPU201/202/203 (retrace hazards)
+* :mod:`.taxonomy` — KTPU301/302/303 (fallback-reason taxonomy drift)
+* :mod:`.envreg` — KTPU401/402 (``KTPU_*`` knob registry drift)
+* :mod:`.catalog_pass` — KTPU501/502/503 (metric catalog drift; the
+  framework home of ``scripts/check_metric_names.py``)
+* :mod:`.knobs` — the machine-readable ``KTPU_*`` knob registry that
+  drives both KTPU401/402 and the README knob table
+"""
+
+from .core import (Analyzer, Finding, Rule, RULES, load_baseline,  # noqa: F401
+                   write_baseline)
+
+# importing the pass modules registers their rules
+from . import trace_safety  # noqa: F401,E402
+from . import retrace  # noqa: F401,E402
+from . import taxonomy  # noqa: F401,E402
+from . import envreg  # noqa: F401,E402
+from . import catalog_pass  # noqa: F401,E402

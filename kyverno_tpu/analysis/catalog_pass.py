@@ -1,0 +1,203 @@
+"""Metric-catalog passes (KTPU5xx) — the framework home of what
+``scripts/check_metric_names.py`` used to do standalone (the script is
+now a thin shim over this module; its allowlist semantics, module API,
+and exit codes are unchanged).
+
+* **KTPU501** — a registry write (``inc`` / ``observe`` / ``set_gauge``
+  / ``clear_gauge`` / ``register_histogram``) uses a metric name absent
+  from ``observability/catalog.py``.
+* **KTPU502** — a write site whose name argument is neither a string
+  literal nor a resolvable UPPER_CASE module constant (uncheckable —
+  use a constant).
+* **KTPU503** — dead metric: a cataloged name with no write site in
+  the tree (``DEAD_METRIC_ALLOWLIST`` names the deliberate
+  exceptions, each with the reason it may exist without an emitter).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Context, Finding, SourceFile, register
+
+WRITE_METHODS = {'inc', 'observe', 'set_gauge', 'clear_gauge',
+                 'register_histogram'}
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE = os.path.join(REPO_ROOT, 'kyverno_tpu')
+CATALOG_PATH = os.path.join(PACKAGE, 'observability', 'catalog.py')
+
+#: catalog entries with no write site in the tree that are legitimately
+#: alive — the ONLY names the dead-metric pass may skip, each with the
+#: reason it is allowed to exist without an emitter
+DEAD_METRIC_ALLOWLIST = {
+    'kyverno_client_queries_total':
+        'reserved for a real cluster client transport (dclient '
+        'interface exists; the in-memory fake does not emit queries)',
+}
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, str]:
+    """UPPER_CASE module-level string assignments (metric name consts)."""
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id.isupper():
+                    consts[target.id] = node.value.value
+    return consts
+
+
+def collect_from_files(files: List[SourceFile]
+                       ) -> Tuple[List[Tuple[SourceFile, int, str]],
+                                  List[Tuple[SourceFile, int, str]]]:
+    """(resolved [(file, line, metric_name)], unresolved
+    [(file, line, description)]) across a parsed file set."""
+    all_consts: Dict[str, str] = {}
+    for sf in files:
+        if sf.tree is not None:
+            all_consts.update(_module_constants(sf.tree))
+    resolved: List[Tuple[SourceFile, int, str]] = []
+    unresolved: List[Tuple[SourceFile, int, str]] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        local_consts = _module_constants(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in WRITE_METHODS and node.args):
+                continue
+            arg = node.args[0]
+            name: Optional[str] = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.Name):
+                name = local_consts.get(arg.id, all_consts.get(arg.id))
+            elif isinstance(arg, ast.Attribute):
+                # module.CONST spelling: resolve by attribute name
+                name = all_consts.get(arg.attr)
+            if name is None:
+                unresolved.append((sf, node.lineno, ast.dump(arg)[:80]))
+            else:
+                resolved.append((sf, node.lineno, name))
+    return resolved, unresolved
+
+
+def load_catalog() -> Dict[str, Tuple[str, str]]:
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from kyverno_tpu.observability.catalog import METRICS
+    return {name: (m.type, m.help) for name, m in METRICS.items()}
+
+
+@register('KTPU501', 'metric write site with a name missing from '
+                     'observability/catalog.py')
+def _check_uncataloged(ctx: Context) -> Iterable[Finding]:
+    catalog = load_catalog()
+    resolved, _unresolved = collect_from_files(ctx.files)
+    for sf, line, name in resolved:
+        if name not in catalog:
+            yield sf.finding(
+                'KTPU501', line,
+                f'metric {name!r} is not in observability/catalog.py '
+                f'— catalog it with a type and help text')
+
+
+@register('KTPU502', 'metric write site whose name is not a literal '
+                     'or module constant (uncheckable)')
+def _check_unresolved(ctx: Context) -> Iterable[Finding]:
+    _resolved, unresolved = collect_from_files(ctx.files)
+    for sf, line, desc in unresolved:
+        yield sf.finding(
+            'KTPU502', line,
+            f'metric name is not a literal or module constant '
+            f'({desc}) — uncheckable, use a constant')
+
+
+@register('KTPU503', 'dead metric: cataloged name with no write site '
+                     'in the tree')
+def _check_dead_metrics(ctx: Context) -> Iterable[Finding]:
+    catalog = load_catalog()
+    resolved, _unresolved = collect_from_files(ctx.files)
+    used = {name for _sf, _l, name in resolved}
+    anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
+    for name in sorted(catalog):
+        if name in used or name in DEAD_METRIC_ALLOWLIST:
+            continue
+        target = anchor if anchor is not None else ctx.files[0]
+        line = 1
+        if anchor is not None:
+            for i, text in enumerate(anchor.lines, start=1):
+                if f"'{name}'" in text:
+                    line = i
+                    break
+        yield target.finding(
+            'KTPU503', line,
+            f'catalog: {name} has no write site in the tree — remove '
+            f'the entry, add the emitter, or allowlist it with a '
+            f'reason (DEAD_METRIC_ALLOWLIST)')
+
+
+# -- standalone API for the scripts/check_metric_names.py shim ---------------
+
+def default_sources() -> List[str]:
+    """The historical checker file set: the package, scripts/, and
+    bench.py."""
+    return [PACKAGE, os.path.join(REPO_ROOT, 'scripts'),
+            os.path.join(REPO_ROOT, 'bench.py')]
+
+
+def collect_call_sites() -> Tuple[List[Tuple[str, int, str]],
+                                  List[Tuple[str, int, str]]]:
+    """Original shim signature: (resolved [(relpath, line, name)],
+    unresolved [(relpath, line, desc)]), walking the real tree fresh
+    on every call."""
+    from .core import collect_files
+    files = collect_files(default_sources(), REPO_ROOT)
+    resolved, unresolved = collect_from_files(files)
+    return ([(sf.rel, line, name) for sf, line, name in resolved],
+            [(sf.rel, line, desc) for sf, line, desc in unresolved])
+
+
+def check_main() -> int:
+    """Exit-code semantics of the original standalone checker."""
+    catalog = load_catalog()
+    resolved, unresolved = collect_call_sites()
+    errors: List[str] = []
+    for name, (mtype, mhelp) in catalog.items():
+        if mtype not in ('counter', 'gauge', 'histogram'):
+            errors.append(f'catalog: {name} has invalid type {mtype!r}')
+        if not mhelp.strip():
+            errors.append(f'catalog: {name} has empty help text')
+    used = {name for _r, _l, name in resolved}
+    for rel, line, name in resolved:
+        if name not in catalog:
+            errors.append(
+                f'{rel}:{line}: metric {name!r} not in '
+                f'observability/catalog.py')
+    for rel, line, desc in unresolved:
+        errors.append(
+            f'{rel}:{line}: metric name is not a literal or module '
+            f'constant ({desc}) — uncheckable, use a constant')
+    for name in catalog:
+        if name not in used and name not in DEAD_METRIC_ALLOWLIST:
+            errors.append(
+                f'catalog: {name} has no write site in the tree — '
+                f'remove the entry, add the emitter, or allowlist it '
+                f'with a reason (DEAD_METRIC_ALLOWLIST)')
+    if not resolved:
+        errors.append('no metric call sites found — checker is broken')
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1
+    print(f'ok: {len(resolved)} call sites over {len(used)} metrics, '
+          f'{len(catalog)} cataloged')
+    return 0

@@ -1,0 +1,228 @@
+"""Jit-entry call graph: which functions can run under a trace.
+
+The trace-safety (KTPU1xx) and retrace (KTPU2xx) passes share one
+over-approximated reachability question: *could this function's body
+execute inside ``jax.jit``?*  Entry points are functions passed to
+``jax.jit`` / ``pjit`` (call form) or decorated with them; edges are
+resolved statically:
+
+* bare-name calls → defs in the same file (any nesting level);
+* ``from M import f`` calls → ``f``'s top-level def in ``M`` when ``M``
+  is part of the analyzed tree (relative imports resolved against the
+  importing module's package, function-level imports included);
+* ``alias.f(...)`` calls where ``alias`` imports a tree module → that
+  module's ``f``;
+* ``obj.method(...)`` calls → same-file defs named ``method`` when the
+  name is unambiguous there (covers ``self.x`` and helper-class
+  methods without pretending to do type inference).
+
+This deliberately over-approximates (a shared method name pulls in
+every same-file homonym) — for lint purposes a false reachable edge
+costs a reviewed suppression, a false unreachable edge hides a real
+host sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, SourceFile
+
+FuncKey = Tuple[str, int]  # (file rel, def lineno)
+
+
+def walk_scope(fn: ast.AST):
+    """Walk ``fn``'s subtree without descending into nested def/class
+    scopes — nested functions are analyzed as their own (reachable)
+    scopes, so walking them twice double-reports every finding."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass
+class ModuleInfo:
+    sf: SourceFile
+    dotted: Optional[str]                      # dotted module name, if known
+    defs: Dict[str, List[ast.AST]] = field(default_factory=dict)
+    # local name -> ('module', dotted) | ('func', dotted, name)
+    imports: Dict[str, Tuple] = field(default_factory=dict)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+
+def _dotted_for(rel: str) -> Optional[str]:
+    """Dotted module path for files that live in a package directory
+    (``kyverno_tpu/ops/eval.py`` → ``kyverno_tpu.ops.eval``)."""
+    if not rel.endswith('.py'):
+        return None
+    parts = rel[:-3].replace(os.sep, '/').split('/')
+    if parts[-1] == '__init__':
+        parts = parts[:-1]
+    return '.'.join(parts) if parts else None
+
+
+def _resolve_relative(dotted: Optional[str], level: int,
+                      module: Optional[str]) -> Optional[str]:
+    if level == 0:
+        return module
+    if dotted is None:
+        return None
+    base = dotted.split('.')
+    # inside module X.Y.Z, `from . import` resolves against X.Y
+    base = base[:-1]
+    if level > 1:
+        base = base[:-(level - 1)] if level - 1 <= len(base) else []
+    if not base and module is None:
+        return None
+    return '.'.join(base + (module.split('.') if module else []))
+
+
+class JitGraph:
+    def __init__(self, ctx: Context):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        for sf in ctx.files:
+            if sf.tree is None:
+                continue
+            mi = ModuleInfo(sf, _dotted_for(sf.rel))
+            for node in ast.walk(sf.tree):
+                for child in ast.iter_child_nodes(node):
+                    mi.parents[child] = node
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    mi.defs.setdefault(node.name, []).append(node)
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        mi.imports[alias.asname or
+                                   alias.name.split('.')[0]] = \
+                            ('module', alias.name)
+                elif isinstance(node, ast.ImportFrom):
+                    src = _resolve_relative(mi.dotted, node.level,
+                                            node.module)
+                    if src is None:
+                        continue
+                    for alias in node.names:
+                        local = alias.asname or alias.name
+                        mi.imports[local] = ('from', src, alias.name)
+            self.modules[sf.rel] = mi
+            if mi.dotted:
+                self.by_dotted[mi.dotted] = mi
+        self.entries: List[Tuple[ModuleInfo, ast.AST, ast.AST]] = []
+        self._find_entries()
+        self.reachable: Set[FuncKey] = set()
+        self._walk_reachable()
+
+    # -- entry detection -----------------------------------------------------
+
+    @staticmethod
+    def is_jit_callable(func: ast.AST) -> bool:
+        """``jax.jit`` / ``jit`` / ``pjit`` in call or decorator
+        position (including ``partial(jax.jit, ...)``)."""
+        if isinstance(func, ast.Name):
+            return func.id in ('jit', 'pjit')
+        if isinstance(func, ast.Attribute):
+            return func.attr in ('jit', 'pjit')
+        return False
+
+    def _find_entries(self) -> None:
+        for mi in self.modules.values():
+            tree = mi.sf.tree
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and \
+                        self.is_jit_callable(node.func) and node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Name):
+                        for d in mi.defs.get(target.id, []):
+                            self.entries.append((mi, d, node))
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        call = dec if isinstance(dec, ast.Call) else None
+                        if self.is_jit_callable(dec) or (
+                                call is not None and (
+                                    self.is_jit_callable(call.func) or
+                                    any(self.is_jit_callable(a)
+                                        for a in call.args))):
+                            self.entries.append((mi, node, dec))
+
+    # -- reachability --------------------------------------------------------
+
+    def _callees(self, mi: ModuleInfo, fn: ast.AST
+                 ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        out: List[Tuple[ModuleInfo, ast.AST]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                name = f.id
+                if name in mi.defs:
+                    out.extend((mi, d) for d in mi.defs[name])
+                    continue
+                imp = mi.imports.get(name)
+                if imp and imp[0] == 'from':
+                    tgt = self.by_dotted.get(imp[1])
+                    if tgt is not None:
+                        out.extend((tgt, d)
+                                   for d in tgt.defs.get(imp[2], []))
+            elif isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name):
+                    imp = mi.imports.get(base.id)
+                    if imp is not None:
+                        if imp[0] == 'module':
+                            tgt = self.by_dotted.get(imp[1])
+                        else:
+                            tgt = self.by_dotted.get(f'{imp[1]}.{imp[2]}')
+                        if tgt is not None:
+                            out.extend((tgt, d)
+                                       for d in tgt.defs.get(f.attr, []))
+                            continue
+                # unqualified method call: same-file defs by attr name
+                out.extend((mi, d) for d in mi.defs.get(f.attr, []))
+        return out
+
+    def _walk_reachable(self) -> None:
+        work: List[Tuple[ModuleInfo, ast.AST]] = \
+            [(mi, fn) for mi, fn, _site in self.entries]
+        while work:
+            mi, fn = work.pop()
+            key = (mi.sf.rel, fn.lineno)
+            if key in self.reachable:
+                continue
+            self.reachable.add(key)
+            work.extend(self._callees(mi, fn))
+
+    # -- queries -------------------------------------------------------------
+
+    def reachable_functions(self):
+        """Yield ``(SourceFile, FunctionDef)`` for every function whose
+        body may execute under a jit trace."""
+        for mi in self.modules.values():
+            for defs in mi.defs.values():
+                for d in defs:
+                    if (mi.sf.rel, d.lineno) in self.reachable:
+                        yield mi.sf, mi, d
+
+    def enclosing_scopes(self, mi: ModuleInfo, fn: ast.AST) -> List[ast.AST]:
+        """Lexically enclosing function scopes (innermost first), then
+        the module."""
+        out: List[ast.AST] = []
+        node = mi.parents.get(fn)
+        while node is not None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+                out.append(node)
+            node = mi.parents.get(node)
+        return out
+
+
+def jit_graph(ctx: Context) -> JitGraph:
+    return ctx.cached('jitgraph', lambda: JitGraph(ctx))

@@ -1,0 +1,211 @@
+"""Retrace-hazard passes (KTPU2xx).
+
+``jax.jit`` caches compiled executables on (input avals × static
+args × closure constants captured at trace time).  Three program
+shapes defeat that cache silently:
+
+* **KTPU201** — a jit-wrapped function *reads* a mutable container
+  (list/dict/set) bound at module or enclosing-function scope.  The
+  trace bakes in whatever the container held at trace time; later
+  mutations are invisible to the compiled executable (stale results),
+  and "fixing" that by retracing per call is a retrace storm.
+* **KTPU202** — ``static_argnums`` / ``static_argnames`` pointing at a
+  parameter whose default is an unhashable container: the first call
+  with the default raises ``TypeError: unhashable``, and call sites
+  passing fresh literals retrace on every call (equality-hashed cache
+  keys never hit).
+* **KTPU203** — Python ``if`` / ``while`` on ``.shape`` / ``.ndim``
+  inside a jit-reachable function: legal (shapes are trace-static) but
+  every distinct shape takes a different branch → one executable per
+  shape.  Intentional shape-bucketing gets a ``# ktpu: noqa[KTPU203]``
+  with the reason; accidental shape branching gets rewritten.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .core import Context, Finding, register
+from .jitgraph import jit_graph, walk_scope
+
+_MUTABLE_CTORS = {'list', 'dict', 'set', 'defaultdict', 'OrderedDict',
+                  'deque', 'Counter'}
+
+
+def _is_mutable_container(value: Optional[ast.AST]) -> bool:
+    if value is None:
+        return False
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(value, ast.Call):
+        f = value.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _scope_bindings(scope: ast.AST) -> dict:
+    """name → last top-level assignment value in ``scope`` (direct
+    statements only; nested function bodies are their own scopes)."""
+    out = {}
+    body = getattr(scope, 'body', [])
+    stack = list(body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.value is not None:
+            out[node.target.id] = node.value
+        elif isinstance(node, (ast.If, ast.For, ast.While, ast.With,
+                               ast.Try)):
+            for attr in ('body', 'orelse', 'finalbody'):
+                stack.extend(getattr(node, attr, []) or [])
+            for h in getattr(node, 'handlers', []) or []:
+                stack.extend(h.body)
+    return out
+
+
+def _local_names(fn: ast.AST) -> set:
+    names = {a.arg for a in fn.args.args + fn.args.kwonlyargs +
+             getattr(fn.args, 'posonlyargs', [])}
+    if fn.args.vararg:
+        names.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            t = node.target
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+@register('KTPU201', 'jit-wrapped function reads a mutable module-'
+                     'global or enclosing-scope container (trace bakes '
+                     'in stale state / retrace storm)')
+def _check_mutable_closure(ctx: Context) -> Iterable[Finding]:
+    graph = jit_graph(ctx)
+    seen = set()
+    for mi, fn, _site in graph.entries:
+        key = (mi.sf.rel, fn.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        local = _local_names(fn)
+        scopes = graph.enclosing_scopes(mi, fn)
+        bindings = {}
+        # outermost (module) first so inner scopes shadow outer ones
+        for scope in reversed(scopes):
+            bindings.update(_scope_bindings(scope))
+        flagged = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and
+                    isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if name in local or name in flagged:
+                continue
+            if _is_mutable_container(bindings.get(name)):
+                flagged.add(name)
+                yield mi.sf.finding(
+                    'KTPU201', node,
+                    f'jit-wrapped `{fn.name}` reads mutable container '
+                    f'`{name}` from an enclosing scope — the trace '
+                    f'captures its trace-time contents; freeze it '
+                    f'(tuple) or pass it as an argument')
+
+
+def _static_params(call: ast.Call, fn: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(param name, default) pairs selected by static_argnums/names."""
+    args = fn.args
+    params = getattr(args, 'posonlyargs', []) + args.args
+    defaults: dict = {}
+    if args.defaults:
+        for p, d in zip(params[-len(args.defaults):], args.defaults):
+            defaults[p.arg] = d
+    for p, d in zip(args.kwonlyargs, args.kw_defaults or []):
+        if d is not None:
+            defaults[p.arg] = d
+    selected: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == 'static_argnums':
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int) and \
+                        v.value < len(params):
+                    selected.append(params[v.value].arg)
+        elif kw.arg == 'static_argnames':
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    selected.append(v.value)
+    return [(n, defaults[n]) for n in selected if n in defaults]
+
+
+@register('KTPU202', 'static jit argument with an unhashable '
+                     '(mutable-container) default — cache keys cannot '
+                     'hash, calls retrace or raise')
+def _check_static_args(ctx: Context) -> Iterable[Finding]:
+    graph = jit_graph(ctx)
+    for mi, fn, site in graph.entries:
+        if not isinstance(site, ast.Call):
+            continue
+        for name, default in _static_params(site, fn):
+            if _is_mutable_container(default):
+                yield mi.sf.finding(
+                    'KTPU202', site,
+                    f'static arg `{name}` of jit-wrapped `{fn.name}` '
+                    f'defaults to an unhashable container — use a '
+                    f'tuple/frozenset or drop it from static_arg*')
+
+
+def _mentions_shape(test: ast.AST) -> Optional[str]:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in ('shape', 'ndim'):
+            return node.attr
+    return None
+
+
+@register('KTPU203', 'shape-dependent Python branching inside a '
+                     'jit-reachable function (one executable per '
+                     'distinct shape)')
+def _check_shape_branch(ctx: Context) -> Iterable[Finding]:
+    graph = jit_graph(ctx)
+    for sf, _mi, fn in graph.reachable_functions():
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                attr = _mentions_shape(node.test)
+                if attr is not None:
+                    kw = 'if' if isinstance(node, ast.If) else 'while'
+                    yield sf.finding(
+                        'KTPU203', node,
+                        f'`{kw}` on `.{attr}` in jit-reachable '
+                        f'`{fn.name}` retraces per distinct shape — '
+                        f'bucket shapes deliberately (and noqa with '
+                        f'the reason) or make the code rank-generic')

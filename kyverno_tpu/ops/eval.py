@@ -1163,6 +1163,8 @@ def build_evaluator(cps: CompiledPolicySet):
 
     def broadcast(arr, depth: int):
         """Append trailing element axes so arr has depth element dims."""
+        # ktpu: noqa[KTPU203] -- deliberate: rank pads to the element
+        # depth baked into this executable (one trace per depth)
         while arr.ndim < depth + 1:
             arr = arr[..., None]
         tgt = (arr.shape[0],) + (dims['E'],) * depth
@@ -1244,6 +1246,8 @@ def build_evaluator(cps: CompiledPolicySet):
                 else:
                     out = cond_tf(t, check_prefix(check), check)
                 cond_cache[check] = out
+            # ktpu: noqa[KTPU203] -- deliberate rank specialization:
+            # const-folded conditions broadcast to the element depth
             if depth > 0 and out.t.ndim == 1:
                 out = _K(broadcast(out.t, depth), broadcast(out.f, depth))
             return out
@@ -1267,6 +1271,8 @@ def build_evaluator(cps: CompiledPolicySet):
             return _K(known_arr & tt, known_arr & ff)
         parts = [eval_expr(t, c, depth) for c in expr.children]
         nd = max(p.t.ndim for p in parts)
+        # ktpu: noqa[KTPU203] -- deliberate rank specialization: scalar
+        # parts broadcast against element-scoped parts per trace
         if any(p.t.ndim != nd for p in parts):
             # scalar parts (const-folded conditions) broadcast against
             # element-scoped [R, FE] parts via trailing axes
@@ -1383,6 +1389,8 @@ def build_evaluator(cps: CompiledPolicySet):
         if kind in ('cond', 'global', 'equality', 'negation'):
             view = _View(t, slot_prefix[node.slot])
             present = view.tag != TAG_MISSING
+            # ktpu: noqa[KTPU203] -- deliberate: slot rank vs node depth
+            # is a compile-time program property, not a batch shape
             if view.tag.ndim - 1 < depth:
                 present = broadcast(present, depth)
             if kind == 'negation':
@@ -1492,7 +1500,9 @@ def build_evaluator(cps: CompiledPolicySet):
                 for eg in entry.err_gathers:
                     elem_err = elem_err | t[f'{elem_prefix[eg]}_notfound']
                 def at_elem(k: _K) -> _K:
-                    if k.t.ndim == 1:  # fully const-folded conditions
+                    # ktpu: noqa[KTPU203] -- deliberate rank
+                    # specialization for const-folded conditions
+                    if k.t.ndim == 1:
                         return _K(k.t[:, None], k.f[:, None])
                     return k
                 if entry.precond is not None:
@@ -1576,6 +1586,9 @@ def build_evaluator(cps: CompiledPolicySet):
             if _unit.kind == 'any':
                 uniq_any.append((_u, len(_unit.children)))
                 _aux_u_total += len(_unit.children)
+    # frozen before any trace closes over it: a tuple can never drift
+    # under a cached executable (ktpu-lint KTPU201)
+    uniq_any = tuple(uniq_any)
     n_cols = len(cps.programs) + _aux_cols
     n_cols_u = n_uniq + _aux_u_total
     # program-space column -> unique-space column, for host expansion
@@ -1649,6 +1662,9 @@ def build_evaluator(cps: CompiledPolicySet):
     fdet_k = int(os.environ.get('KTPU_FDET_K', '32'))
 
     def evaluate_packed(packed: Dict[str, jnp.ndarray]):
+        # ktpu: noqa[KTPU201] -- layout is trace-static by contract:
+        # compile_lock serializes every trace, and the AOT cache key
+        # bakes the batch layout into the executable's identity
         t = unpack_batch(packed, layout_holder['layout'])
         match = t.pop('__match__', None)
         if match is None:

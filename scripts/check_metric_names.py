@@ -4,152 +4,30 @@ methods (``inc`` / ``observe`` / ``set_gauge`` / ``clear_gauge`` /
 ``register_histogram``) must be cataloged in
 ``kyverno_tpu/observability/catalog.py`` with a type and help text.
 
-Metric names drift silently: a typo'd name forks a series and the
-dashboards keep reading the dead one.  This walks the tree's ASTs,
-resolves each call site's name argument (string literal, or an
-UPPER_CASE module-level constant defined anywhere in the tree), and
-fails on any name missing from the catalog — wired into tier-1 via
-``tests/test_metric_catalog.py``.
+This is now a thin shim over the ktpu-lint framework's catalog pass
+(``kyverno_tpu/analysis/catalog_pass.py``, rules KTPU501/502/503 in
+``scripts/analyze.py``) — kept so existing invocations, the module API
+used by ``tests/test_metric_catalog.py``, and the dead-metric
+allowlist semantics keep working unchanged.
 
 Exit status: 0 clean, 1 violations (listed on stderr).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
-
-WRITE_METHODS = {'inc', 'observe', 'set_gauge', 'clear_gauge',
-                 'register_histogram'}
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO_ROOT, 'kyverno_tpu')
-CATALOG_PATH = os.path.join(PACKAGE, 'observability', 'catalog.py')
+sys.path.insert(0, REPO_ROOT)
 
-
-#: catalog entries with no write site in the tree that are legitimately
-#: alive — the ONLY names the dead-metric pass may skip, each with the
-#: reason it is allowed to exist without an emitter
-DEAD_METRIC_ALLOWLIST = {
-    'kyverno_client_queries_total':
-        'reserved for a real cluster client transport (dclient '
-        'interface exists; the in-memory fake does not emit queries)',
-}
-
-
-def _iter_sources() -> List[str]:
-    out = []
-    # scripts/ is walked too: tooling must not emit uncataloged series
-    for root in (PACKAGE, os.path.join(REPO_ROOT, 'scripts')):
-        for base, _dirs, files in os.walk(root):
-            for name in files:
-                if name.endswith('.py'):
-                    out.append(os.path.join(base, name))
-    out.append(os.path.join(REPO_ROOT, 'bench.py'))
-    return sorted(p for p in out if os.path.exists(p))
-
-
-def _module_constants(tree: ast.Module) -> Dict[str, str]:
-    """UPPER_CASE module-level string assignments (metric name consts)."""
-    consts: Dict[str, str] = {}
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and \
-                isinstance(node.value, ast.Constant) and \
-                isinstance(node.value.value, str):
-            for target in node.targets:
-                if isinstance(target, ast.Name) and target.id.isupper():
-                    consts[target.id] = node.value.value
-    return consts
-
-
-def collect_call_sites() -> Tuple[List[Tuple[str, int, str]],
-                                  List[Tuple[str, int, str]]]:
-    """Returns (resolved [(path, line, metric_name)], unresolved
-    [(path, line, description)]) across the tree."""
-    sources = _iter_sources()
-    trees: Dict[str, ast.Module] = {}
-    all_consts: Dict[str, str] = {}
-    for path in sources:
-        with open(path, encoding='utf-8') as f:
-            try:
-                tree = ast.parse(f.read(), filename=path)
-            except SyntaxError as e:
-                print(f'{path}: syntax error: {e}', file=sys.stderr)
-                continue
-        trees[path] = tree
-        all_consts.update(_module_constants(tree))
-
-    resolved: List[Tuple[str, int, str]] = []
-    unresolved: List[Tuple[str, int, str]] = []
-    for path, tree in trees.items():
-        local_consts = _module_constants(tree)
-        rel = os.path.relpath(path, REPO_ROOT)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and
-                    isinstance(node.func, ast.Attribute) and
-                    node.func.attr in WRITE_METHODS and node.args):
-                continue
-            arg = node.args[0]
-            name: Optional[str] = None
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                name = arg.value
-            elif isinstance(arg, ast.Name):
-                name = local_consts.get(arg.id, all_consts.get(arg.id))
-            elif isinstance(arg, ast.Attribute):
-                # module.CONST spelling: resolve by attribute name
-                name = all_consts.get(arg.attr)
-            if name is None:
-                unresolved.append((rel, node.lineno,
-                                   ast.dump(arg)[:80]))
-            else:
-                resolved.append((rel, node.lineno, name))
-    return resolved, unresolved
-
-
-def load_catalog() -> Dict[str, Tuple[str, str]]:
-    sys.path.insert(0, REPO_ROOT)
-    from kyverno_tpu.observability.catalog import METRICS
-    return {name: (m.type, m.help) for name, m in METRICS.items()}
+from kyverno_tpu.analysis.catalog_pass import (  # noqa: E402,F401
+    CATALOG_PATH, DEAD_METRIC_ALLOWLIST, PACKAGE, WRITE_METHODS,
+    check_main, collect_call_sites, load_catalog)
 
 
 def main() -> int:
-    catalog = load_catalog()
-    resolved, unresolved = collect_call_sites()
-    errors: List[str] = []
-    for name, (mtype, mhelp) in catalog.items():
-        if mtype not in ('counter', 'gauge', 'histogram'):
-            errors.append(f'catalog: {name} has invalid type {mtype!r}')
-        if not mhelp.strip():
-            errors.append(f'catalog: {name} has empty help text')
-    used = {name for _r, _l, name in resolved}
-    for rel, line, name in resolved:
-        if name not in catalog:
-            errors.append(
-                f'{rel}:{line}: metric {name!r} not in '
-                f'observability/catalog.py')
-    for rel, line, desc in unresolved:
-        errors.append(
-            f'{rel}:{line}: metric name is not a literal or module '
-            f'constant ({desc}) — uncheckable, use a constant')
-    # dead-metric pass: a cataloged name with no write site anywhere in
-    # the tree is fiction — dashboards read a series that never exists
-    for name in catalog:
-        if name not in used and name not in DEAD_METRIC_ALLOWLIST:
-            errors.append(
-                f'catalog: {name} has no write site in the tree — '
-                f'remove the entry, add the emitter, or allowlist it '
-                f'with a reason (DEAD_METRIC_ALLOWLIST)')
-    if not resolved:
-        errors.append('no metric call sites found — checker is broken')
-    if errors:
-        for e in errors:
-            print(e, file=sys.stderr)
-        return 1
-    print(f'ok: {len(resolved)} call sites over {len(used)} metrics, '
-          f'{len(catalog)} cataloged')
-    return 0
+    return check_main()
 
 
 if __name__ == '__main__':

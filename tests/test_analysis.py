@@ -1,0 +1,456 @@
+"""ktpu-lint framework tests: one positive and one negative fixture
+per rule id (deleting a rule's implementation fails its fixture test),
+plus suppression semantics and baseline round-trips."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from kyverno_tpu.analysis import Analyzer, RULES, write_baseline  # noqa: E402
+from kyverno_tpu.analysis.knobs import KNOBS  # noqa: E402
+from kyverno_tpu.observability.catalog import METRICS  # noqa: E402
+from kyverno_tpu.observability.coverage import REASONS  # noqa: E402
+
+
+def run(tmp_path, sources, rules=None, baseline=None):
+    """Write {relpath: source} under tmp_path and analyze it."""
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    a = Analyzer([str(tmp_path)], str(tmp_path),
+                 baseline_path=baseline, rules=rules)
+    return a.run()
+
+
+def rule_ids(report):
+    return {f.rule_id for f in report.active}
+
+
+JIT_PRELUDE = """\
+    import jax
+    import jax.numpy as jnp
+"""
+
+
+# -- KTPU1xx: trace safety ---------------------------------------------------
+
+def test_ktpu101_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        x = jnp.sum(t)
+        return x.item()
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        return jnp.sum(t)
+    jf = jax.jit(f)
+
+    def host_only(t):
+        return t.item()
+    """}, rules=['KTPU101'])
+    assert not rep.active  # .item() outside the jit graph is fine
+
+
+def test_ktpu101_transitive_reachability(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def helper(t):
+        return t.tolist()
+
+    def f(t):
+        return helper(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+
+
+def test_ktpu102_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        return int(jnp.sum(t))
+    jf = jax.jit(f)
+    """}, rules=['KTPU102'])
+    assert rule_ids(rep) == {'KTPU102'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t, n):
+        return t * int(n)
+    jf = jax.jit(f)
+    """}, rules=['KTPU102'])
+    assert not rep.active  # cast of a plain python value
+
+
+def test_ktpu103_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        y = jnp.sum(t)
+        if y > 0:
+            return t
+        return -t
+    jf = jax.jit(f)
+    """}, rules=['KTPU103'])
+    assert rule_ids(rep) == {'KTPU103'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t, mask):
+        if mask is None:
+            return t
+        return jnp.where(mask, t, 0)
+    jf = jax.jit(f)
+    """}, rules=['KTPU103'])
+    assert not rep.active  # `is None` gates optionality, not tracers
+
+
+# -- KTPU2xx: retrace hazards ------------------------------------------------
+
+def test_ktpu201_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    STATE = {}
+
+    def f(t):
+        return t + len(STATE)
+    jf = jax.jit(f)
+    """}, rules=['KTPU201'])
+    assert rule_ids(rep) == {'KTPU201'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    STATE = (1, 2)
+
+    def f(t):
+        return t + len(STATE)
+    jf = jax.jit(f)
+    """}, rules=['KTPU201'])
+    assert not rep.active  # tuples cannot drift under the executable
+
+
+def test_ktpu201_enclosing_scope(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def build():
+        holder = {'k': None}
+
+        def f(t):
+            return t + len(holder)
+        return jax.jit(f)
+    """}, rules=['KTPU201'])
+    assert rule_ids(rep) == {'KTPU201'}
+
+
+def test_ktpu202_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def g(x, cfg=[1]):
+        return x
+    jg = jax.jit(g, static_argnums=1)
+    """}, rules=['KTPU202'])
+    assert rule_ids(rep) == {'KTPU202'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def g(x, cfg=(1,)):
+        return x
+    jg = jax.jit(g, static_argnums=1)
+    """}, rules=['KTPU202'])
+    assert not rep.active
+
+
+def test_ktpu203_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        if t.ndim == 1:
+            return t[:, None]
+        return t
+    jf = jax.jit(f)
+    """}, rules=['KTPU203'])
+    assert rule_ids(rep) == {'KTPU203'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        return jnp.expand_dims(t, -1)
+    jf = jax.jit(f)
+    """}, rules=['KTPU203'])
+    assert not rep.active
+
+
+# -- KTPU3xx: fallback taxonomy ----------------------------------------------
+
+def test_ktpu301_positive_negative(tmp_path):
+    rep = run(tmp_path, {'compiler/c.py': """\
+    from ..compiler.ir import CompileError
+
+    def compile_rule(rule):
+        raise CompileError('nope', reason='not_a_real_reason')
+    """}, rules=['KTPU301'])
+    assert rule_ids(rep) == {'KTPU301'}
+    rep = run(tmp_path, {'compiler/c.py': """\
+    from ..compiler.ir import CompileError
+
+    def compile_rule(rule):
+        raise CompileError('nope', reason='host_closure')
+    """}, rules=['KTPU301'])
+    assert not rep.active
+
+
+def test_ktpu302_positive_negative(tmp_path):
+    rep = run(tmp_path, {'compiler/c.py': """\
+    FALLBACK = object()
+
+    def bad(doc):
+        if not isinstance(doc, dict):
+            return FALLBACK
+        return doc
+    """}, rules=['KTPU302'])
+    assert rule_ids(rep) == {'KTPU302'}
+    rep = run(tmp_path, {'compiler/c.py': """\
+    FALLBACK = object()
+
+    def good(doc, record_fallback):
+        if not isinstance(doc, dict):
+            record_fallback('mutate', 'non_dict_intermediate')
+            return FALLBACK
+        return doc
+    """}, rules=['KTPU302'])
+    assert not rep.active
+
+
+def test_ktpu302_scoped_to_compiler(tmp_path):
+    rep = run(tmp_path, {'engine/c.py': """\
+    FALLBACK = object()
+
+    def bad(doc):
+        return FALLBACK
+    """}, rules=['KTPU302'])
+    assert not rep.active
+
+
+def test_ktpu303_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU303'])
+    # no reference site anywhere → every taxonomy reason is dead
+    assert rule_ids(rep) == {'KTPU303'}
+    assert len(rep.active) == len(REASONS)
+    refs = ''.join(
+        f"    raise CompileError('x', reason='{slug}')\n"
+        for slug in sorted(REASONS))
+    rep = run(tmp_path, {'a.py': 'def f():\n' + refs},
+              rules=['KTPU303'])
+    assert not rep.active
+
+
+# -- KTPU4xx: env-knob registry ----------------------------------------------
+
+def test_ktpu401_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    import os
+    V = os.environ.get('KTPU_NOT_A_KNOB', '1')
+    """}, rules=['KTPU401'])
+    assert rule_ids(rep) == {'KTPU401'}
+    rep = run(tmp_path, {'a.py': """\
+    import os
+    V = os.environ.get('KTPU_WARM', '1')
+    W = __import__('os').environ.get('KTPU_SCAN_CHUNK', '16384')
+    """}, rules=['KTPU401'])
+    assert not rep.active
+
+
+def test_ktpu402_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU402'])
+    assert rule_ids(rep) == {'KTPU402'}
+    assert len(rep.active) == len(KNOBS)
+    reads = 'import os\n' + ''.join(
+        f"V{i} = os.environ.get('{name}')\n"
+        for i, name in enumerate(sorted(KNOBS)))
+    rep = run(tmp_path, {'a.py': reads}, rules=['KTPU402'])
+    assert not rep.active
+
+
+# -- KTPU5xx: metric catalog -------------------------------------------------
+
+def test_ktpu501_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg):
+        reg.inc('kyverno_tpu_not_in_catalog_total')
+    """}, rules=['KTPU501'])
+    assert rule_ids(rep) == {'KTPU501'}
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg):
+        reg.inc('kyverno_tpu_host_fallback_total')
+    """}, rules=['KTPU501'])
+    assert not rep.active
+
+
+def test_ktpu502_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, name):
+        reg.inc(name)
+    """}, rules=['KTPU502'])
+    assert rule_ids(rep) == {'KTPU502'}
+    rep = run(tmp_path, {'a.py': """\
+    METRIC = 'kyverno_tpu_host_fallback_total'
+
+    def emit(reg):
+        reg.inc(METRIC)
+    """}, rules=['KTPU502'])
+    assert not rep.active
+
+
+def test_ktpu503_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU503'])
+    assert rule_ids(rep) == {'KTPU503'}
+    writes = 'def emit(reg):\n' + ''.join(
+        f"    reg.inc('{name}')\n" for name in sorted(METRICS))
+    rep = run(tmp_path, {'a.py': writes}, rules=['KTPU503'])
+    assert not rep.active
+
+
+# -- KTPU00x: suppression hygiene (meta rules) -------------------------------
+
+def test_ktpu001_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    X = 1  # ktpu: noqa[KTPU101]
+    """}, rules=['KTPU001'])
+    assert rule_ids(rep) == {'KTPU001'}
+    rep = run(tmp_path, {'a.py': """\
+    X = 1  # ktpu: noqa[KTPU101] -- justified example
+    """}, rules=['KTPU001'])
+    assert not rep.active
+
+
+def test_ktpu002_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    X = 1  # ktpu: noqa[KTPU101] -- suppresses nothing
+    """}, rules=['KTPU002'])
+    assert rule_ids(rep) == {'KTPU002'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        return t.item()  # ktpu: noqa[KTPU101] -- fixture host sync
+    jf = jax.jit(f)
+    """}, rules=['KTPU101', 'KTPU002'])
+    assert not rep.active
+    assert [f.rule_id for f in rep.suppressed] == ['KTPU101']
+
+
+# -- suppression semantics ---------------------------------------------------
+
+def test_noqa_suppresses_only_listed_rule(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        return t.item()  # ktpu: noqa[KTPU203] -- wrong rule id
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+
+
+def test_noqa_comment_block_above(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t):
+        # ktpu: noqa[KTPU101] -- wrapped reason text continues on
+        # the next comment line without breaking the suppression
+        return t.item()
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert not rep.active
+    assert len(rep.suppressed) == 1
+
+
+def test_noqa_in_docstring_is_inert(tmp_path):
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + '''\
+    def f(t):
+        """Docs may quote `# ktpu: noqa[KTPU101] -- like so`."""
+        return t.item()
+    jf = jax.jit(f)
+    '''}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+
+
+# -- baseline round-trip -----------------------------------------------------
+
+BAD_SRC = """\
+import jax
+import jax.numpy as jnp
+
+def f(t):
+    return t.item()
+jf = jax.jit(f)
+"""
+
+FIXED_SRC = """\
+import jax
+import jax.numpy as jnp
+
+def f(t):
+    return jnp.sum(t)
+jf = jax.jit(f)
+"""
+
+DRIFTED_SRC = """\
+import jax
+import jax.numpy as jnp
+
+PAD = 1
+
+def f(t):
+    return t.item()
+jf = jax.jit(f)
+"""
+
+
+def test_baseline_round_trip(tmp_path):
+    bl = str(tmp_path / 'baseline.json')
+    rep = run(tmp_path, {'a.py': BAD_SRC}, rules=['KTPU101'])
+    assert len(rep.active) == 1
+    write_baseline(bl, rep.active, reason='grandfathered in the test')
+    rep2 = run(tmp_path, {'a.py': BAD_SRC}, rules=['KTPU101'],
+               baseline=bl)
+    assert not rep2.active
+    assert len(rep2.baselined) == 1
+    assert not rep2.stale_baseline
+    assert not rep2.errors
+
+
+def test_baseline_stale_entry_detected(tmp_path):
+    bl = str(tmp_path / 'baseline.json')
+    rep = run(tmp_path, {'a.py': BAD_SRC}, rules=['KTPU101'])
+    write_baseline(bl, rep.active, reason='grandfathered in the test')
+    rep2 = run(tmp_path, {'a.py': FIXED_SRC}, rules=['KTPU101'],
+               baseline=bl)
+    assert not rep2.active
+    assert len(rep2.stale_baseline) == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl = tmp_path / 'baseline.json'
+    bl.write_text(json.dumps({'entries': [
+        {'rule': 'KTPU101', 'path': 'a.py', 'match': 'return t.item()',
+         'reason': ''}]}))
+    rep = run(tmp_path, {'a.py': BAD_SRC}, rules=['KTPU101'],
+              baseline=str(bl))
+    assert rep.errors  # unjustified entry is an error even if it matches
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    bl = str(tmp_path / 'baseline.json')
+    rep = run(tmp_path, {'a.py': BAD_SRC}, rules=['KTPU101'])
+    write_baseline(bl, rep.active, reason='grandfathered in the test')
+    rep2 = run(tmp_path, {'a.py': DRIFTED_SRC}, rules=['KTPU101'],
+               baseline=bl)
+    assert not rep2.active
+    assert len(rep2.baselined) == 1
+
+
+# -- registry hygiene --------------------------------------------------------
+
+def test_rule_registry_complete():
+    expected = {'KTPU001', 'KTPU002', 'KTPU101', 'KTPU102', 'KTPU103',
+                'KTPU201', 'KTPU202', 'KTPU203', 'KTPU301', 'KTPU302',
+                'KTPU303', 'KTPU401', 'KTPU402', 'KTPU501', 'KTPU502',
+                'KTPU503'}
+    assert set(RULES) == expected
+    for rid, rule in RULES.items():
+        assert rule.summary.strip(), rid
+
+
+def test_knob_table_renders_every_knob():
+    from kyverno_tpu.analysis.knobs import render_knob_table
+    table = render_knob_table()
+    for name in KNOBS:
+        assert f'`{name}`' in table

@@ -1,0 +1,97 @@
+"""Tier-1 gate: the ktpu-lint analyzer runs clean over the tree.
+
+``python scripts/analyze.py --strict`` must exit 0 — any new
+trace-safety / retrace / taxonomy / knob / catalog violation fails CI
+here, before a TPU ever sees the code.  The committed baseline must be
+minimal (no stale entries) and every entry justified."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+BASELINE = os.path.join(REPO_ROOT, '.ktpu-baseline.json')
+
+
+def _run_analyzer(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'scripts',
+                                      'analyze.py'), *args],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+
+
+def test_tree_is_clean_in_strict_mode():
+    t0 = time.monotonic()
+    proc = _run_analyzer('--strict', '--json')
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report['counts']['active'] == 0, report['active']
+    assert report['counts']['stale_baseline'] == 0, \
+        report['stale_baseline']
+    assert not report['errors'], report['errors']
+    # CPU-only CI budget: the whole tree must analyze fast
+    assert elapsed < 10.0, f'analyzer took {elapsed:.1f}s (budget 10s)'
+
+
+def test_baseline_is_minimal_and_justified():
+    """Every committed baseline entry still matches a real finding
+    (in-process re-run, so a stale entry names itself) and carries a
+    non-placeholder justification."""
+    from kyverno_tpu.analysis import Analyzer
+    with open(BASELINE, encoding='utf-8') as f:
+        entries = json.load(f)['entries']
+    for e in entries:
+        reason = str(e.get('reason', '')).strip()
+        assert reason and not reason.startswith('TODO'), \
+            f'unjustified baseline entry: {e}'
+    analyzer = Analyzer(['kyverno_tpu', 'scripts', 'bench.py'],
+                        REPO_ROOT, baseline_path=BASELINE)
+    report = analyzer.run()
+    assert not report.stale_baseline, report.stale_baseline
+    assert not report.active, [f.render() for f in report.active]
+    # the baseline is exercised, not vestigial: each entry matched
+    assert len(report.baselined) >= len(entries)
+
+
+def test_analyzer_catches_planted_violation(tmp_path):
+    """End-to-end through the driver: a rogue file with a host sync in
+    a jit function must flip --strict to nonzero."""
+    rogue = os.path.join(REPO_ROOT, 'kyverno_tpu', '_rogue_lint.py')
+    with open(rogue, 'w') as f:
+        f.write('import jax\n\n'
+                'def _f(t):\n'
+                '    return t.item()\n\n'
+                '_jf = jax.jit(_f)\n')
+    try:
+        proc = _run_analyzer('--strict')
+        assert proc.returncode != 0
+        assert 'KTPU101' in proc.stdout
+    finally:
+        os.unlink(rogue)
+
+
+def test_knob_table_matches_registry():
+    """--knob-table output covers every registered knob, and the README
+    carries the generated table (docs cannot drift from the registry)."""
+    from kyverno_tpu.analysis.knobs import KNOBS
+    proc = _run_analyzer('--knob-table')
+    assert proc.returncode == 0
+    readme = open(os.path.join(REPO_ROOT, 'README.md'),
+                  encoding='utf-8').read()
+    for name in KNOBS:
+        assert f'`{name}`' in proc.stdout, name
+        assert name in readme, f'{name} missing from README knob table'
+
+
+def test_rule_ids_documented_in_readme():
+    from kyverno_tpu.analysis import RULES
+    readme = open(os.path.join(REPO_ROOT, 'README.md'),
+                  encoding='utf-8').read()
+    for rid in RULES:
+        assert rid in readme, f'{rid} missing from README rule table'
