@@ -547,6 +547,14 @@ def load_policy_pack():
             profiles=('baseline', 'restricted'))
     except Exception as e:  # noqa: BLE001 - charts are additive
         print(f'chart load failed: {e}', file=sys.stderr)
+    if not docs:
+        # hermetic container without the reference checkout: the
+        # embedded two-policy pack keeps every bench mode runnable
+        # (the JSON line's n_policies records the degraded scale)
+        import yaml as _yaml
+        docs = [d for d in _yaml.safe_load_all(PACK) if d]
+        print('reference packs missing; using the embedded PACK',
+              file=sys.stderr)
     return [Policy(d) for d in docs]
 
 
@@ -742,8 +750,16 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     # admission latency through the full serving chain at ~1k policies
     # (BASELINE metric: 'p50 webhook latency @1k policies')
     _progress('admission latency @1k policies')
+    adm_ctx = _admission_server(policies, sieve_pods)
     lat_p50_ms, lat_p99_ms, lat_n_policies, adm_device = admission_latency(
-        policies, sieve_pods)
+        policies, sieve_pods, ctx=adm_ctx)
+
+    # concurrent admission through the micro-batcher (KTPU_SERVING=batch):
+    # decisions/s and batch occupancy vs client thread count, on the
+    # same compiled serving chain
+    _progress('concurrent admission (batch serving)')
+    adm_concurrency = admission_concurrency(adm_ctx, sieve_pods)
+    adm_ctx[1].shutdown()
 
     # fresh-process warm time with the persistent compilation cache
     _progress('fresh-process cache probe')
@@ -785,27 +801,27 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'admission_p99_ms': lat_p99_ms,
         'admission_n_policies': lat_n_policies,
         'admission_device_served': adm_device,
+        'admission_concurrency': adm_concurrency,
     }
     if warning:
         result['warning'] = warning
     return result
 
 
-def admission_latency(policies, resources, target_policies=1000,
-                      samples=120):
-    """p50/p99 latency of /validate through the full handler chain with
-    the pack replicated to ~1k policies (enforce mode).  The device-path
-    build wait is bounded (BENCH_ADMISSION_WAIT_S) so the bench always
-    finishes; ``device_served`` in the result records whether the
-    sampled latencies rode the compiled path."""
+def _admission_server(policies, resources, target_policies=1000):
+    """Replicated-enforce serving chain shared by the admission latency
+    and concurrency benches (one ~1k-policy scanner compile serves
+    both).  Returns ``(server, handlers, n_replicated, device_served)``;
+    the device-path build wait is bounded (BENCH_ADMISSION_WAIT_S) so
+    the bench always finishes."""
     import copy
-    import json as _json
-    import statistics
     from kyverno_tpu.policycache.cache import Cache
     from kyverno_tpu.api.policy import Policy
     from kyverno_tpu.webhooks.handlers import ResourceHandlers
     from kyverno_tpu.webhooks.server import WebhookServer
 
+    if not policies:
+        raise ValueError('empty policy pack: nothing to replicate')
     replicated = []
     i = 0
     while len(replicated) < target_policies:
@@ -822,9 +838,9 @@ def admission_latency(policies, resources, target_policies=1000,
     handlers = ResourceHandlers(cache)
     server = WebhookServer(handlers)
     # scanner builds happen on a background thread (requests host-loop
-    # meanwhile); the latency figure is the steady state, so wait for
-    # the compiled path before sampling — but bounded, so a slow build
-    # degrades the reported numbers instead of timing out the bench
+    # meanwhile); the steady-state figures want the compiled path, so
+    # wait for it — but bounded, so a slow build degrades the reported
+    # numbers instead of timing out the bench
     from kyverno_tpu.policycache import cache as pcache
     ns0 = resources[0]['metadata'].get('namespace', '')
     enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod', ns0)
@@ -833,29 +849,128 @@ def admission_latency(policies, resources, target_policies=1000,
         wait_s = float(os.environ.get('BENCH_ADMISSION_WAIT_S', '90'))
         device_served = handlers.wait_device_ready(enforce,
                                                    timeout=wait_s)
+    return server, handlers, len(replicated), device_served
+
+
+def _admission_review(doc: dict, uid: str) -> bytes:
+    import json as _json
+    return _json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': uid, 'operation': 'CREATE',
+            'kind': {'group': '', 'version': 'v1',
+                     'kind': doc.get('kind', '')},
+            'namespace': doc['metadata'].get('namespace', ''),
+            'name': doc['metadata'].get('name', ''),
+            'object': doc, 'userInfo': {'username': 'bench'},
+        }}).encode()
+
+
+def admission_latency(policies, resources, target_policies=1000,
+                      samples=120, ctx=None):
+    """p50/p99 latency of /validate through the full handler chain with
+    the pack replicated to ~1k policies (enforce mode); ``device_served``
+    records whether the sampled latencies rode the compiled path.
+    ``ctx`` reuses a prebuilt ``_admission_server`` tuple."""
+    import statistics
+    server, _handlers, n_replicated, device_served = \
+        ctx if ctx is not None else _admission_server(
+            policies, resources, target_policies)
     if not device_served:
         samples = min(samples, 30)  # host-loop latencies are ~10x — keep
         # the degraded sampling inside the bench budget
     lat = []
     for k in range(samples):
         doc = resources[k % len(resources)]
-        review = _json.dumps({
-            'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
-            'request': {
-                'uid': f'u{k}', 'operation': 'CREATE',
-                'kind': {'group': '', 'version': 'v1',
-                         'kind': doc.get('kind', '')},
-                'namespace': doc['metadata'].get('namespace', ''),
-                'name': doc['metadata'].get('name', ''),
-                'object': doc, 'userInfo': {'username': 'bench'},
-            }}).encode()
+        review = _admission_review(doc, f'u{k}')
         t0 = time.time()
         server.handle('/validate/fail', review)
         lat.append((time.time() - t0) * 1000)
     lat.sort()
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
     return (round(statistics.median(lat), 2), round(p99, 2),
-            len(replicated), device_served)
+            n_replicated, device_served)
+
+
+def admission_concurrency(ctx, resources, thread_counts=None,
+                          requests_per_thread=25):
+    """Concurrent-admission serving bench: switch the shared handler
+    chain to ``KTPU_SERVING=batch`` and drive it with N client threads
+    — the micro-batcher coalesces their scans into shared device
+    dispatches.  One block per thread count:
+    ``{threads, decisions_per_s, batch_occupancy_p50,
+    queue_wait_p50_ms, shed_total}``."""
+    import threading
+    server, handlers, _n_replicated, device_served = ctx
+    if thread_counts is None:
+        spec = os.environ.get('BENCH_ADMISSION_THREADS', '1,8,32')
+        thread_counts = [int(t) for t in spec.split(',') if t.strip()]
+    prior_mode = handlers.serving_mode
+    handlers.serving_mode = 'batch'
+    blocks = []
+    try:
+        for n_threads in thread_counts:
+            batcher = handlers._get_batcher()
+            batcher.reset_stats()
+            barrier = threading.Barrier(n_threads + 1)
+
+            def work(tid, n_threads=n_threads):
+                barrier.wait()
+                for k in range(requests_per_thread):
+                    doc = resources[(tid * requests_per_thread + k)
+                                    % len(resources)]
+                    server.handle('/validate/fail',
+                                  _admission_review(doc, f't{tid}k{k}'))
+
+            threads = [threading.Thread(target=work, args=(tid,))
+                       for tid in range(n_threads)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.time()
+            for t in threads:
+                t.join()
+            elapsed = time.time() - t0
+            stats = batcher.stats()
+            decisions = n_threads * requests_per_thread
+            blocks.append({
+                'threads': n_threads,
+                'decisions_per_s': round(decisions / elapsed, 1)
+                if elapsed > 0 else 0.0,
+                'batch_occupancy_p50': stats['occupancy_p50'],
+                'batch_occupancy_mean': round(stats['occupancy_mean'], 2),
+                'queue_wait_p50_ms': round(stats['queue_wait_p50_ms'], 3),
+                'shed_total': stats['shed_total'],
+                'device_served': device_served,
+            })
+            _progress(f'admission concurrency: {n_threads} threads -> '
+                      f"{blocks[-1]['decisions_per_s']}/s, occupancy "
+                      f"p50 {blocks[-1]['batch_occupancy_p50']}")
+    finally:
+        handlers.serving_mode = prior_mode
+    return blocks
+
+
+def admission_concurrency_main(platform: str) -> int:
+    """``bench.py --admission-concurrency``: run only the
+    concurrent-admission serving block (CI-sized; scale the policy set
+    with BENCH_ADMISSION_POLICIES, threads with
+    BENCH_ADMISSION_THREADS)."""
+    import random
+    policies = load_policy_pack()
+    rng = random.Random(42)
+    pods = [make_pod(rng, i) for i in range(256)]
+    target = int(os.environ.get('BENCH_ADMISSION_POLICIES', '1000'))
+    _progress(f'admission serving chain @{target} policies')
+    ctx = _admission_server(policies, pods, target_policies=target)
+    blocks = admission_concurrency(ctx, pods)
+    ctx[1].shutdown()
+    print(json.dumps({
+        'metric': 'admission_concurrency', 'platform': platform,
+        'n_policies': ctx[2], 'device_served': ctx[3],
+        'admission_concurrency': blocks,
+    }))
+    return 0
 
 
 def main() -> int:
@@ -885,6 +1000,16 @@ def main() -> int:
     # of the measured traffic actually ran on device (and why the rest
     # fell back) alongside the latency numbers
     coverage_ledger.configure(reg)
+    if '--admission-concurrency' in sys.argv[1:]:
+        try:
+            return admission_concurrency_main(platform)
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': 'admission_concurrency', 'platform': platform,
+                'error': f'{type(e).__name__}: {e}'}))
+            return 1
     # BENCH_CONFIG=4|5 runs the scaled BASELINE configs; default is the
     # north-star background scan
     config = os.environ.get('BENCH_CONFIG', '')

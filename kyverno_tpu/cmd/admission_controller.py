@@ -74,6 +74,11 @@ class AdmissionController:
         self.reconciler = WebhookConfigReconciler(
             setup.client, self.cert_renewer.ca_bundle(),
             setup.options.namespace)
+        # graceful shutdown (LIFO): stop the server first — which
+        # drains the admission micro-batcher so queued futures resolve
+        # — then close the event/audit workers
+        setup.register_shutdown(self.close)
+        setup.register_shutdown(self.server.stop)
         self.elector = None
         if setup.options.leader_election:
             self.elector = LeaderElector(setup.client, 'kyverno',
@@ -183,8 +188,7 @@ class AdmissionController:
         self.server.start()
         self.setup.install_signal_handlers()
         self.setup.run_until_stopped(self.tick, interval=5.0)
-        self.server.stop()
-        self.close()
+        self.setup.shutdown()
         if self.elector is not None:
             self.elector.release()
 

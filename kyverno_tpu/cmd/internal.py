@@ -73,6 +73,9 @@ class Setup:
         self.stop_event = threading.Event()
         # populated by start_aot_warmer (admission controller)
         self.aot_warmer = None
+        # LIFO shutdown hooks (drain the admission batcher, stop
+        # servers); run by shutdown() when the daemon loop exits
+        self._shutdown_hooks: List[Callable[[], None]] = []
         # profiling + tracing (reference: setup.go:21 setup order)
         self.profiling_server = None
         if getattr(self.options, 'profile', False):
@@ -95,6 +98,24 @@ class Setup:
         warmer.start()
         self.aot_warmer = warmer
         return warmer
+
+    def register_shutdown(self, hook: Callable[[], None]) -> None:
+        """Register a graceful-shutdown hook (run LIFO by shutdown()).
+        The admission controller registers WebhookServer.stop here,
+        which drains the serving micro-batcher — queued admission
+        futures resolve before the process exits."""
+        self._shutdown_hooks.append(hook)
+
+    def shutdown(self) -> None:
+        """Run registered shutdown hooks, newest first.  Hooks must be
+        idempotent (the daemon run loop may also call them directly);
+        a failing hook is logged and never blocks the rest."""
+        while self._shutdown_hooks:
+            hook = self._shutdown_hooks.pop()
+            try:
+                hook()
+            except Exception:  # noqa: BLE001
+                self.logger.exception('shutdown hook failed')
 
     def install_signal_handlers(self) -> None:
         def handler(signum, frame):

@@ -60,6 +60,21 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_device_coverage_ratio': Metric(
         'gauge', 'Device-decided fraction of the most recent scan '
         '(device_rows / total_rows).'),
+    # admission micro-batching scheduler (serving/)
+    'kyverno_tpu_admission_queue_depth': Metric(
+        'gauge', 'Pending requests in the admission micro-batch queue '
+        '(KTPU_QUEUE_CAP bounds it; overflow sheds to the host loop).'),
+    'kyverno_tpu_admission_batch_occupancy': Metric(
+        'histogram', 'Coalesced requests per shared device dispatch '
+        '(flushes on the KTPU_BATCH_WINDOW_MS window or at '
+        'KTPU_BATCH_MAX occupancy).'),
+    'kyverno_tpu_admission_queue_wait_seconds': Metric(
+        'histogram', 'Time a request waited in the admission queue '
+        'before its batch dispatched.'),
+    'kyverno_tpu_admission_shed_total': Metric(
+        'counter', 'Requests shed from the batched fast path to the '
+        'host engine loop, by reason=queue_full|deadline|scan_error|'
+        'shutdown (never a 500).'),
     # AOT cache + warm-up instruments (aotcache/)
     'kyverno_tpu_aot_warm_duration_seconds': Metric(
         'histogram', 'Background warm-up wall time by target/state '

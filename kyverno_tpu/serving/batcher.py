@@ -1,0 +1,250 @@
+"""The micro-batching loop: coalesce same-policy-set admission scans.
+
+One daemon thread watches the bounded queue.  It picks the oldest
+pending ticket, waits until that ticket's flush window expires
+(``KTPU_BATCH_WINDOW_MS``, default ~2ms) or its key reaches
+``KTPU_BATCH_MAX`` occupancy (default 64 — the compiled small-batch
+bucket floor in ``compiler/scan.py``, so a full batch pads exactly like
+a sync scan and introduces no new XLA shapes), then dispatches all
+claimed tickets of that key as ONE ``scanner.scan`` call and resolves
+their futures row by row.
+
+Dispatches are serialized on the batcher thread: ``BatchScanner.scan``
+keeps per-scan state on the scanner instance, and one consumer at a
+time is what makes the shared scanner safe by construction.  While a
+dispatch runs, new arrivals accumulate in the queue — that accumulation
+is where occupancy (and chip utilization) comes from.
+
+Failure semantics: a dispatch that raises sheds every rider to the host
+engine loop (reason ``scan_error``) and reports one failure to the
+owning handler's per-policy-set circuit breaker — identical to the sync
+path's recovery, amortized over the batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..observability import tracing
+from ..observability.metrics import MetricsRegistry, global_registry
+from . import shed as shed_policy
+from .queue import RequestQueue, Ticket
+
+QUEUE_DEPTH = 'kyverno_tpu_admission_queue_depth'
+BATCH_OCCUPANCY = 'kyverno_tpu_admission_batch_occupancy'
+QUEUE_WAIT = 'kyverno_tpu_admission_queue_wait_seconds'
+
+#: occupancy counts requests per dispatch — power-of-two buckets up to
+#: twice the default KTPU_BATCH_MAX
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+#: queue waits live at the flush window (~ms), far below the default
+#: latency buckets' useful resolution
+WAIT_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0)
+
+
+def admission_key(admission: tuple) -> str:
+    """Canonical string of the (admission_info, exclude_group_roles,
+    namespace_labels, operation) tuple.  Requests may only share a
+    dispatch when this matches byte-for-byte: match/exclude semantics
+    (roles, subjects, namespaceSelector) depend on these values, and
+    bit-identity with the sync path is the contract."""
+    return json.dumps(admission, sort_keys=True, default=str,
+                      separators=(',', ':'))
+
+
+class AdmissionBatcher:
+    """Queue + coalescing thread + shed accounting.
+
+    ``on_success(policies)`` / ``on_failure(policies, error)`` hook the
+    owning handler's circuit breaker, so a broken backend trips it from
+    batched traffic exactly as it would from sync traffic.
+    """
+
+    def __init__(self,
+                 window_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 queue_cap: Optional[int] = None,
+                 shed_deadline_ms: Optional[float] = None,
+                 on_success: Optional[Callable] = None,
+                 on_failure: Optional[Callable] = None):
+        if window_ms is None:
+            window_ms = float(os.environ.get('KTPU_BATCH_WINDOW_MS', '2'))
+        if max_batch is None:
+            max_batch = int(os.environ.get('KTPU_BATCH_MAX', '64'))
+        if queue_cap is None:
+            queue_cap = int(os.environ.get('KTPU_QUEUE_CAP', '256'))
+        if shed_deadline_ms is None:
+            shed_deadline_ms = float(os.environ.get(
+                'KTPU_SHED_DEADLINE_MS', '500'))
+        self.window_s = window_ms / 1000.0
+        self.max_batch = max(1, max_batch)
+        self.shed_deadline_s = shed_deadline_ms / 1000.0
+        self.queue = RequestQueue(max(1, queue_cap))
+        self.sheds = shed_policy.ShedLedger()
+        self.on_success = on_success
+        self.on_failure = on_failure
+        self._stats_lock = threading.Lock()
+        self._occupancies: deque = deque(maxlen=4096)
+        self._waits_s: deque = deque(maxlen=8192)
+        self._dispatches = 0
+        self._requests = 0
+        self._registered_on: Optional[MetricsRegistry] = None
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name='ktpu-admission-batcher', daemon=True)
+        self._thread.start()
+
+    # -- submission (webhook threads) -------------------------------------
+
+    def submit(self, resource: dict, context: Optional[dict], pctx,
+               admission: tuple, scanner, policies) -> Ticket:
+        """Enqueue one request; raises QueueFull / Stopped (callers shed
+        to the host loop).  The current span rides along so the batch
+        span nests under the request's HTTP-handler span."""
+        ticket = Ticket(
+            key=(id(scanner), admission_key(admission)),
+            resource=resource, context=context, pctx=pctx,
+            admission=admission, scanner=scanner, policies=policies,
+            span=tracing.current_span(), on_shed=self.sheds.record)
+        self.queue.put(ticket)
+        self._set_depth()
+        return ticket
+
+    def record_shed(self, reason: str) -> None:
+        self.sheds.record(reason)
+
+    # -- the coalescing loop ----------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            first = self.queue.wait_for_work()
+            if first is None:
+                return  # stopping and drained
+            self.queue.wait_flush(first.key, self.max_batch,
+                                  first.enqueued_at + self.window_s)
+            batch = self.queue.take_batch(first.key, self.max_batch)
+            self._set_depth()
+            if batch:
+                self._dispatch(batch)
+
+    def _dispatch(self, batch) -> None:
+        t0 = time.monotonic()
+        lead = batch[0]
+        scanner = lead.scanner
+        resources = [t.resource for t in batch]
+        contexts = [t.context for t in batch]
+        # host materialization must see each request's own
+        # PolicyContext; scan hands the factory the resource document,
+        # which is this request's freshly parsed dict
+        pctx_of = {id(t.resource): t.pctx for t in batch}
+        lead_pctx = lead.pctx
+
+        def pctx_factory(doc):
+            return pctx_of.get(id(doc), lead_pctx)
+
+        self._observe(batch, t0)
+        try:
+            with tracing.tracer().start_span(
+                    'kyverno/serving/batch',
+                    {'occupancy': len(batch),
+                     'window_ms': self.window_s * 1000.0},
+                    parent=lead.span):
+                rows = scanner.scan(resources, contexts=contexts,
+                                    admission=lead.admission,
+                                    pctx_factory=pctx_factory)
+        except Exception as e:  # noqa: BLE001 - riders shed, never a 500
+            for t in batch:
+                t.shed(shed_policy.REASON_SCAN_ERROR)
+                self.sheds.record(shed_policy.REASON_SCAN_ERROR)
+            if self.on_failure is not None:
+                self.on_failure(lead.policies, e)
+            return
+        for t, row in zip(batch, rows):
+            t.resolve(row)
+        if self.on_success is not None:
+            self.on_success(lead.policies)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _registry(self) -> Optional[MetricsRegistry]:
+        registry = global_registry()
+        if registry is not None and registry is not self._registered_on:
+            # bucket overrides must land before the first observe; the
+            # calls are no-ops once each histogram exists
+            registry.register_histogram(BATCH_OCCUPANCY,
+                                        OCCUPANCY_BUCKETS)
+            registry.register_histogram(QUEUE_WAIT, WAIT_BUCKETS)
+            self._registered_on = registry
+        return registry
+
+    def _set_depth(self) -> None:
+        registry = self._registry()
+        if registry is not None:
+            registry.set_gauge(QUEUE_DEPTH, self.queue.depth())
+
+    def _observe(self, batch, t0: float) -> None:
+        waits = [t0 - t.enqueued_at for t in batch]
+        with self._stats_lock:
+            self._dispatches += 1
+            self._requests += len(batch)
+            self._occupancies.append(len(batch))
+            self._waits_s.extend(waits)
+        registry = self._registry()
+        if registry is not None:
+            registry.observe(BATCH_OCCUPANCY, float(len(batch)))
+            for w in waits:
+                registry.observe(QUEUE_WAIT, w)
+
+    @staticmethod
+    def _p50(values) -> float:
+        data = sorted(values)
+        return data[len(data) // 2] if data else 0.0
+
+    def stats(self) -> Dict[str, object]:
+        """Local counters for benchmarks/tests (no registry needed)."""
+        with self._stats_lock:
+            occ = list(self._occupancies)
+            waits = list(self._waits_s)
+            dispatches = self._dispatches
+            requests = self._requests
+        return {
+            'dispatches': dispatches,
+            'requests': requests,
+            'occupancy_mean': (sum(occ) / len(occ)) if occ else 0.0,
+            'occupancy_p50': self._p50(occ),
+            'queue_wait_p50_ms': self._p50(waits) * 1000.0,
+            'shed_total': self.sheds.total(),
+            'shed': self.sheds.counts(),
+            'queue_depth': self.queue.depth(),
+        }
+
+    def reset_stats(self) -> None:
+        with self._stats_lock:
+            self._occupancies.clear()
+            self._waits_s.clear()
+            self._dispatches = 0
+            self._requests = 0
+        self.sheds.reset()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the loop.  ``drain=True`` (shutdown path) dispatches
+        every pending ticket first — their waiting webhook threads get
+        real batched responses; ``drain=False`` sheds them to the host
+        loop immediately."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if not drain:
+            for t in self.queue.take_all():
+                t.shed(shed_policy.REASON_SHUTDOWN)
+                self.sheds.record(shed_policy.REASON_SHUTDOWN)
+        self.queue.stop()
+        self._thread.join(timeout=timeout)

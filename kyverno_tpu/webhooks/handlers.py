@@ -245,7 +245,8 @@ class ResourceHandlers:
                  registry_client=None,
                  device: bool = True,
                  openapi_manager=None,
-                 client=None):
+                 client=None,
+                 serving_mode: Optional[str] = None):
         if openapi_manager is None:
             from ..openapi.manager import Manager
             openapi_manager = Manager()
@@ -321,6 +322,14 @@ class ResourceHandlers:
         self._dead_keys: 'collections.OrderedDict[tuple, Any]' = \
             collections.OrderedDict()
         self._breaker_cap = 64
+        # admission serving mode: 'batch' routes CREATE-path validate
+        # scans through the micro-batching scheduler (serving/), 'sync'
+        # keeps the per-request dispatch
+        import os as _os
+        self.serving_mode = serving_mode or \
+            _os.environ.get('KTPU_SERVING', 'sync')
+        self._batcher = None
+        self._batcher_lock = threading.Lock()
 
     @staticmethod
     def _policy_key(policies):
@@ -425,6 +434,73 @@ class ResourceHandlers:
             time.sleep(0.05)
         return False
 
+    # -- admission micro-batching (serving/) -------------------------------
+
+    def _get_batcher(self):
+        batcher = self._batcher
+        if batcher is None:
+            with self._batcher_lock:
+                batcher = self._batcher
+                if batcher is None:
+                    from ..serving.batcher import AdmissionBatcher
+                    batcher = AdmissionBatcher(
+                        on_success=self._batch_scan_ok,
+                        on_failure=self._batch_scan_failed)
+                    self._batcher = batcher
+        return batcher
+
+    def _batch_scan_ok(self, policies) -> None:
+        # mirror of the sync path's success bookkeeping: the breaker
+        # counts consecutive failures per set
+        with self._scanner_lock:
+            self._key_failures.pop(self._policy_key(policies), None)
+
+    def _batch_scan_failed(self, policies, error) -> None:
+        # mirror of the sync path's failure recovery: drop the broken
+        # scanner so the next request rebuilds it, and count one breaker
+        # failure for the set (the whole batch sheds on one dispatch, so
+        # a broken backend trips the breaker per dispatch, not per rider)
+        key = self._policy_key(policies)
+        with self._scanner_lock:
+            self._scanners.pop(key, None)
+        self._record_key_failure(
+            key, policies,
+            f'batched scan failed, shedding to host engine: {error}')
+
+    def _batched_scan(self, scanner, policies, request, pctx):
+        """Route one CREATE validate scan through the micro-batcher.
+
+        Returns this request's per-policy responses, or None when the
+        request shed to the host engine loop (queue full, deadline
+        blown, dispatch failed, or batcher stopped) — the caller then
+        serves the identical-verdict host path, never a 500."""
+        from ..serving import shed as shed_policy
+        from ..serving.queue import QueueFull, Stopped
+        batcher = self._get_batcher()
+        resource = admission.request_resource(request)
+        adm = (pctx.admission_info, pctx.exclude_group_roles,
+               pctx.namespace_labels, 'CREATE')
+        try:
+            ticket = batcher.submit(
+                resource=resource, context=pctx.json_context._data,
+                pctx=pctx, admission=adm, scanner=scanner,
+                policies=policies)
+        except QueueFull:
+            batcher.record_shed(shed_policy.REASON_QUEUE_FULL)
+            return None
+        except Stopped:
+            batcher.record_shed(shed_policy.REASON_SHUTDOWN)
+            return None
+        return ticket.wait(batcher.shed_deadline_s)
+
+    def shutdown(self) -> None:
+        """Drain and stop the admission batcher: pending futures get
+        their batched responses before the process exits (wired through
+        WebhookServer.stop and cmd/internal.Setup shutdown hooks)."""
+        batcher = self._batcher
+        if batcher is not None:
+            batcher.stop(drain=True)
+
     # -- validate ---------------------------------------------------------
 
     def validate(self, request: dict,
@@ -455,6 +531,17 @@ class ResourceHandlers:
                 if scanner is None:
                     # compiled path still building: host loop this request
                     use_device = False
+                elif self.serving_mode == 'batch':
+                    # micro-batching scheduler: this request coalesces
+                    # with concurrent same-policy-set requests into one
+                    # shared device dispatch (serving/batcher.py); a
+                    # shed comes back as None and the host loop serves
+                    batched = self._batched_scan(scanner, policies,
+                                                 request, pctx)
+                    if batched is None:
+                        use_device = False
+                    else:
+                        responses = batched
                 else:
                     resource = admission.request_resource(request)
                     [responses] = scanner.scan(

@@ -126,40 +126,80 @@ class WebhookServer:
         return dict(self._routes)
 
     def handle(self, path: str, body: bytes) -> bytes:
-        """Dispatch one POST body through the route's handler chain.
+        """Dispatch one POST body through the route's handler chain
+        (the in-process form ``routes()`` consumers and tests use; the
+        HTTP layer goes through :meth:`handle_request` for the status
+        code)."""
+        out, _status = self.handle_request(path, body)
+        return out
 
-        Each request runs under an HTTP-handler span (reference:
-        pkg/webhooks/handlers/trace.go:16 WithTrace); engine rule spans
-        nest under it via context propagation."""
-        handler = self._routes.get(path)
-        if handler is None:
-            raise KeyError(path)
-        review = json.loads(body)
-        request = admission.parse_review(review)
-        import time as _time
-        from ..observability import tracing
+    @staticmethod
+    def _observe_review(operation: str, allowed: str,
+                        seconds: float) -> None:
         from ..observability.metrics import (ADMISSION_REQUESTS,
                                              ADMISSION_REVIEW_DURATION,
                                              global_registry)
+        registry = global_registry()
+        if registry is None:
+            return
+        registry.observe(ADMISSION_REVIEW_DURATION, seconds,
+                         operation=operation, allowed=allowed)
+        registry.inc(ADMISSION_REQUESTS, operation=operation,
+                     allowed=allowed)
+
+    def handle_request(self, path: str, body: bytes):
+        """Dispatch one POST body; returns ``(response bytes, status)``.
+
+        Each request runs under an HTTP-handler span (reference:
+        pkg/webhooks/handlers/trace.go:16 WithTrace); engine rule spans
+        nest under it via context propagation.
+
+        A body that is not JSON or not an AdmissionReview with a
+        ``request`` object gets a structured, uid-echoing denied
+        response at HTTP 400 — the API server always receives an
+        AdmissionReview it can correlate, never a raw traceback.
+        Handler-chain exceptions still propagate (the HTTP layer 500s)
+        but are recorded with ``allowed=error`` so shed/error traffic
+        is visible on the admission instruments."""
+        handler = self._routes.get(path)
+        if handler is None:
+            raise KeyError(path)
+        import time as _time
+        from ..observability import tracing
         t0 = _time.monotonic()
+        review = None
+        try:
+            review = json.loads(body)
+            request = admission.parse_review(review)
+        except Exception as e:  # noqa: BLE001 - malformed input → 400
+            uid = ''
+            if isinstance(review, dict):
+                req = review.get('request')
+                if isinstance(req, dict):
+                    uid = str(req.get('uid', '') or '')
+            self._observe_review('', 'error', _time.monotonic() - t0)
+            resp = admission.response(
+                uid, False, f'malformed admission review: {e}')
+            return (json.dumps(
+                admission.review_response({}, resp)).encode('utf-8'), 400)
+        operation = request.get('operation', '') or ''
         with tracing.start_span(
                 f'webhooks{path}',
                 {'uid': request.get('uid', ''),
                  'kind': (request.get('kind') or {}).get('kind', ''),
                  'operation': request.get('operation', '')}) as span:
-            resp = handler(request)
+            try:
+                resp = handler(request)
+            except Exception:
+                self._observe_review(operation, 'error',
+                                     _time.monotonic() - t0)
+                raise
             span.set_attribute('allowed', resp.get('allowed'))
-        registry = global_registry()
-        if registry is not None:
-            operation = request.get('operation', '') or ''
-            allowed = str(bool(resp.get('allowed'))).lower()
-            registry.observe(ADMISSION_REVIEW_DURATION,
-                             _time.monotonic() - t0,
-                             operation=operation, allowed=allowed)
-            registry.inc(ADMISSION_REQUESTS, operation=operation,
-                         allowed=allowed)
-        return json.dumps(
-            admission.review_response(request, resp)).encode('utf-8')
+        self._observe_review(operation,
+                             str(bool(resp.get('allowed'))).lower(),
+                             _time.monotonic() - t0)
+        return (json.dumps(
+            admission.review_response(request, resp)).encode('utf-8'), 200)
 
     def warmup_status(self):
         """(json body, http status) for /health/warmup."""
@@ -213,7 +253,7 @@ class WebhookServer:
                 length = int(self.headers.get('Content-Length', 0))
                 body = self.rfile.read(length)
                 try:
-                    out = server.handle(self.path, body)
+                    out, status = server.handle_request(self.path, body)
                 except KeyError:
                     self.send_response(404)
                     self.end_headers()
@@ -223,7 +263,7 @@ class WebhookServer:
                     self.end_headers()
                     self.wfile.write(str(e).encode('utf-8'))
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(out)))
                 self.end_headers()
@@ -280,6 +320,12 @@ class WebhookServer:
 
     def stop(self) -> None:
         self._ready = False
+        # drain the admission micro-batcher before tearing the listener
+        # down: handler threads blocked on batched futures resolve with
+        # real responses instead of timing out mid-shutdown
+        shutdown = getattr(self.resource_handlers, 'shutdown', None)
+        if shutdown is not None:
+            shutdown()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -1,0 +1,382 @@
+"""Admission micro-batching scheduler (kyverno_tpu/serving/).
+
+Pins the serving contract: with ``KTPU_SERVING=batch`` every response
+is bit-identical to the sync path's, overflow/deadline/failure traffic
+sheds to the host engine loop (never an error to the API server), and
+shutdown drains pending futures.  CPU-only, tier-1.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.config.config import Configuration
+from kyverno_tpu.policycache import cache as pcache
+from kyverno_tpu.policycache.cache import Cache
+from kyverno_tpu.serving import shed as shed_policy
+from kyverno_tpu.serving.batcher import AdmissionBatcher
+from kyverno_tpu.serving.queue import (QueueFull, RequestQueue, Stopped,
+                                       Ticket)
+from kyverno_tpu.webhooks.handlers import ResourceHandlers
+from kyverno_tpu.webhooks.server import WebhookServer
+
+ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+
+def pod(labels, name):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'labels': labels},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def review_bytes(resource, uid):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': uid, 'operation': 'CREATE',
+            'kind': {'group': '', 'version': 'v1', 'kind': 'Pod'},
+            'namespace': 'default',
+            'name': resource['metadata']['name'],
+            'object': resource,
+            'userInfo': {'username': 'alice', 'groups': []},
+        }}).encode()
+
+
+@pytest.fixture(scope='module')
+def chain():
+    """One compiled serving chain for the whole module (the scanner
+    compile is the expensive part; every test shares it)."""
+    cache = Cache()
+    cache.warm_up([Policy(d) for d in yaml.safe_load_all(ENFORCE_POLICY)])
+    handlers = ResourceHandlers(cache, configuration=Configuration(),
+                                serving_mode='batch')
+    server = WebhookServer(handlers, configuration=Configuration())
+    enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod', 'default')
+    assert handlers.wait_device_ready(enforce, timeout=600)
+    yield server, handlers
+    handlers.shutdown()
+
+
+@pytest.fixture
+def restore_batcher(chain):
+    """Let a test swap in a custom batcher; the module batcher comes
+    back (and batch mode is restored) afterwards."""
+    _server, handlers = chain
+    prior = handlers._batcher
+    prior_mode = handlers.serving_mode
+    yield handlers
+    custom = handlers._batcher
+    if custom is not None and custom is not prior:
+        custom.stop(drain=True)
+    handlers._batcher = prior
+    handlers.serving_mode = prior_mode
+
+
+def mixed_requests(n):
+    # alternate violating / compliant pods so both verdict paths batch
+    return [(f'u{i}', pod({'team': 'infra'} if i % 2 else {}, f'p{i}'))
+            for i in range(n)]
+
+
+def sync_responses(server, handlers, requests):
+    prior = handlers.serving_mode
+    handlers.serving_mode = 'sync'
+    try:
+        return {uid: server.handle('/validate/fail', review_bytes(p, uid))
+                for uid, p in requests}
+    finally:
+        handlers.serving_mode = prior
+
+
+class TestBatchedServing:
+    def test_stress_bit_identity_and_occupancy(self, chain):
+        """32 client threads: batched responses are byte-identical to
+        the sync path's, and coalescing actually happens (mean
+        occupancy > 1)."""
+        server, handlers = chain
+        handlers._get_batcher().reset_stats()
+        requests = mixed_requests(32 * 8)
+        per_thread = 8
+        results = {}
+        errors = []
+        barrier = threading.Barrier(32)
+
+        def work(tid):
+            barrier.wait()
+            for uid, p in requests[tid * per_thread:
+                                   (tid + 1) * per_thread]:
+                try:
+                    out, status = server.handle_request(
+                        '/validate/fail', review_bytes(p, uid))
+                    assert status == 200
+                    results[uid] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors
+        assert len(results) == len(requests)
+        stats = handlers._get_batcher().stats()
+        assert stats['requests'] + stats['shed_total'] >= len(requests)
+        assert stats['occupancy_mean'] > 1.0, stats
+        expected = sync_responses(server, handlers, requests)
+        for uid, _p in requests:
+            assert results[uid] == expected[uid]
+
+    def test_deadline_flush_under_trickle(self, chain):
+        """A lone request must not wait for riders: the window deadline
+        flushes a batch of one, bit-identical to sync."""
+        server, handlers = chain
+        batcher = handlers._get_batcher()
+        batcher.reset_stats()
+        requests = mixed_requests(5)
+        got = {uid: server.handle('/validate/fail', review_bytes(p, uid))
+               for uid, p in requests}
+        stats = batcher.stats()
+        assert stats['dispatches'] >= 5
+        assert stats['occupancy_p50'] == 1
+        expected = sync_responses(server, handlers, requests)
+        for uid, _p in requests:
+            assert got[uid] == expected[uid]
+
+    def test_queue_full_sheds_to_host_no_500s(self, restore_batcher,
+                                              chain):
+        """Overflowing a capacity-2 queue sheds to the host engine loop:
+        every response stays HTTP 200 and correct, and the shed ledger
+        records queue_full."""
+        server, handlers = chain
+        handlers._batcher = AdmissionBatcher(
+            window_ms=50, queue_cap=2,
+            on_success=handlers._batch_scan_ok,
+            on_failure=handlers._batch_scan_failed)
+        requests = mixed_requests(24)
+        statuses = []
+        results = {}
+        errors = []
+        barrier = threading.Barrier(12)
+
+        def work(tid):
+            barrier.wait()
+            for uid, p in requests[tid * 2:(tid + 1) * 2]:
+                try:
+                    out, status = server.handle_request(
+                        '/validate/fail', review_bytes(p, uid))
+                    statuses.append(status)
+                    results[uid] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors
+        assert statuses == [200] * len(requests)
+        sheds = handlers._batcher.sheds.counts()
+        assert sheds.get(shed_policy.REASON_QUEUE_FULL, 0) >= 1, sheds
+        expected = sync_responses(server, handlers, requests)
+        for uid, _p in requests:
+            assert results[uid] == expected[uid]
+
+    def test_drain_on_stop_resolves_pending(self, restore_batcher,
+                                            chain):
+        """shutdown() drains: tickets parked behind a huge window get
+        real batched responses, and post-stop requests still serve
+        (host loop, shed reason shutdown)."""
+        server, handlers = chain
+        batcher = AdmissionBatcher(
+            window_ms=60_000, queue_cap=64, shed_deadline_ms=30_000,
+            on_success=handlers._batch_scan_ok,
+            on_failure=handlers._batch_scan_failed)
+        handlers._batcher = batcher
+        requests = mixed_requests(3)
+        results = {}
+
+        def work(uid, p):
+            results[uid] = server.handle('/validate/fail',
+                                         review_bytes(p, uid))
+
+        threads = [threading.Thread(target=work, args=r)
+                   for r in requests]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while batcher.queue.depth() < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert batcher.queue.depth() == 3
+        handlers.shutdown()
+        for t in threads:
+            t.join(30)
+        assert len(results) == 3
+        stats = batcher.stats()
+        assert stats['requests'] == 3 and stats['shed_total'] == 0, stats
+        # the stopped batcher sheds new submissions to the host loop
+        uid, p = 'u-after-stop', pod({}, 'p-after-stop')
+        out, status = server.handle_request('/validate/fail',
+                                            review_bytes(p, uid))
+        assert status == 200
+        assert json.loads(out)['response']['allowed'] is False
+        assert batcher.sheds.counts().get(
+            shed_policy.REASON_SHUTDOWN, 0) >= 1
+        expected = sync_responses(server, handlers, requests)
+        for r_uid, _p in requests:
+            assert results[r_uid] == expected[r_uid]
+
+
+class _FakeScanner:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.calls = []
+
+    def scan(self, resources, contexts=None, admission=None,
+             pctx_factory=None):
+        self.calls.append(len(resources))
+        if self.fail:
+            raise RuntimeError('device gone')
+        return [[('row', r['metadata']['name'])] for r in resources]
+
+
+def _submit(batcher, scanner, name, policies=('pol',)):
+    return batcher.submit(
+        resource=pod({}, name), context=None, pctx=None,
+        admission=({'userInfo': {'username': 'a'}}, [], {}, 'CREATE'),
+        scanner=scanner, policies=list(policies))
+
+
+class TestBatcherUnit:
+    def test_scan_error_sheds_all_riders_and_reports_failure(self):
+        failures = []
+        batcher = AdmissionBatcher(
+            window_ms=20, queue_cap=16,
+            on_failure=lambda policies, e: failures.append(str(e)))
+        try:
+            scanner = _FakeScanner(fail=True)
+            tickets = [_submit(batcher, scanner, f'p{i}')
+                       for i in range(3)]
+            rows = [t.wait(shed_after_s=5.0) for t in tickets]
+            assert rows == [None, None, None]
+            assert all(t.shed_reason == shed_policy.REASON_SCAN_ERROR
+                       for t in tickets)
+            counts = batcher.sheds.counts()
+            assert counts.get(shed_policy.REASON_SCAN_ERROR) == 3
+            assert len(failures) >= 1 and 'device gone' in failures[0]
+        finally:
+            batcher.stop(drain=False)
+
+    def test_occupancy_cap_flushes_full_batch(self):
+        batcher = AdmissionBatcher(window_ms=60_000, max_batch=4,
+                                   queue_cap=64)
+        try:
+            scanner = _FakeScanner()
+            tickets = [_submit(batcher, scanner, f'p{i}')
+                       for i in range(4)]
+            rows = [t.wait(shed_after_s=10.0) for t in tickets]
+            # the window was huge: only the occupancy cap can have
+            # flushed this batch
+            assert all(r is not None for r in rows)
+            assert scanner.calls == [4]
+        finally:
+            batcher.stop(drain=False)
+
+    def test_distinct_admission_tuples_never_share_a_dispatch(self):
+        batcher = AdmissionBatcher(window_ms=30, queue_cap=64)
+        try:
+            scanner = _FakeScanner()
+            t1 = batcher.submit(
+                resource=pod({}, 'a'), context=None, pctx=None,
+                admission=({'userInfo': {'username': 'alice'}}, [], {},
+                           'CREATE'),
+                scanner=scanner, policies=['pol'])
+            t2 = batcher.submit(
+                resource=pod({}, 'b'), context=None, pctx=None,
+                admission=({'userInfo': {'username': 'bob'}}, [], {},
+                           'CREATE'),
+                scanner=scanner, policies=['pol'])
+            assert t1.wait(5.0) is not None
+            assert t2.wait(5.0) is not None
+            assert scanner.calls == [1, 1]
+        finally:
+            batcher.stop(drain=False)
+
+    def test_deadline_shed_vs_claim_is_exclusive(self):
+        sheds = []
+        ticket = Ticket(key='k', resource={}, context=None, pctx=None,
+                        admission=(), scanner=None, policies=[],
+                        on_shed=sheds.append)
+        assert ticket.wait(shed_after_s=0.01) is None
+        assert ticket.shed_reason == shed_policy.REASON_DEADLINE
+        assert sheds == [shed_policy.REASON_DEADLINE]
+        # the loser of the CAS cannot claim a shed ticket
+        assert not ticket.claim()
+
+    def test_queue_capacity_and_stop(self):
+        q = RequestQueue(capacity=2)
+        t1 = Ticket('k', {}, None, None, (), None, [])
+        t2 = Ticket('k', {}, None, None, (), None, [])
+        q.put(t1)
+        q.put(t2)
+        with pytest.raises(QueueFull):
+            q.put(Ticket('k', {}, None, None, (), None, []))
+        # a deadline-shed ticket no longer counts against capacity
+        assert t1._try_shed(shed_policy.REASON_DEADLINE)
+        q.put(Ticket('k', {}, None, None, (), None, []))
+        q.stop()
+        with pytest.raises(Stopped):
+            q.put(Ticket('k', {}, None, None, (), None, []))
+
+    def test_metrics_emission(self):
+        from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                                       set_global_registry)
+        from kyverno_tpu.serving.batcher import (BATCH_OCCUPANCY,
+                                                 QUEUE_WAIT)
+        from kyverno_tpu.serving.shed import ADMISSION_SHED
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            batcher = AdmissionBatcher(window_ms=5, queue_cap=8)
+            try:
+                scanner = _FakeScanner()
+                tickets = [_submit(batcher, scanner, f'p{i}')
+                           for i in range(2)]
+                for t in tickets:
+                    assert t.wait(5.0) is not None
+                batcher.record_shed(shed_policy.REASON_QUEUE_FULL)
+                assert registry.histogram_count(
+                    BATCH_OCCUPANCY) >= 1
+                assert registry.histogram_count(QUEUE_WAIT) >= 2
+                assert registry.counter_value(
+                    ADMISSION_SHED,
+                    reason=shed_policy.REASON_QUEUE_FULL) == 1
+            finally:
+                batcher.stop(drain=False)
+        finally:
+            set_global_registry(None)

@@ -405,3 +405,80 @@ class TestHostPolicyAdmissionScreen:
         assert 'team' in out['response']['status']['message']
         out = _json.loads(server.handle('/validate/fail', review(True)))
         assert out['response']['allowed'] is True
+
+
+class TestMalformedReviewHardening:
+    """Malformed bodies get a structured 400 AdmissionReview, and
+    error-path traffic lands on the admission instruments."""
+
+    def test_invalid_json_returns_structured_400(self):
+        server = serve(make_cache(ENFORCE_POLICY))
+        out, status = server.handle_request('/validate/fail',
+                                            b'{not json!')
+        assert status == 400
+        resp = json.loads(out)
+        assert resp['kind'] == 'AdmissionReview'
+        assert resp['response']['allowed'] is False
+        assert 'malformed' in resp['response']['status']['message']
+
+    def test_missing_request_returns_structured_400(self):
+        server = serve(make_cache(ENFORCE_POLICY))
+        body = json.dumps({'apiVersion': 'admission.k8s.io/v1',
+                           'kind': 'AdmissionReview'}).encode()
+        out, status = server.handle_request('/validate/fail', body)
+        assert status == 400
+        resp = json.loads(out)['response']
+        assert resp['allowed'] is False
+        assert resp['uid'] == ''
+
+    def test_non_dict_request_returns_structured_400(self):
+        server = serve(make_cache(ENFORCE_POLICY))
+        body = json.dumps({'request': ['not', 'a', 'dict']}).encode()
+        out, status = server.handle_request('/validate/fail', body)
+        assert status == 400
+        assert json.loads(out)['response']['allowed'] is False
+
+    def test_handle_keeps_bytes_contract(self):
+        # the in-process entry point still returns bytes (and raises
+        # KeyError for unknown routes)
+        server = serve(make_cache(ENFORCE_POLICY))
+        out = server.handle('/validate/fail', b'also not json')
+        assert json.loads(out)['response']['allowed'] is False
+        try:
+            server.handle('/nope', b'{}')
+        except KeyError:
+            pass
+        else:
+            raise AssertionError('unknown route must raise KeyError')
+
+    def test_malformed_and_exception_paths_record_error_metrics(self):
+        from kyverno_tpu.observability.metrics import (
+            ADMISSION_REQUESTS, MetricsRegistry, set_global_registry)
+        from kyverno_tpu.webhooks.server import PolicyHandlers
+
+        class BoomHandlers(PolicyHandlers):
+            def validate(self, request):
+                raise RuntimeError('boom')
+
+        handlers = ResourceHandlers(make_cache(ENFORCE_POLICY))
+        server = WebhookServer(handlers, policy_handlers=BoomHandlers())
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            _out, status = server.handle_request('/validate/fail',
+                                                 b'broken')
+            assert status == 400
+            assert registry.counter_value(
+                ADMISSION_REQUESTS, operation='', allowed='error') == 1
+            body = json.dumps(review(pod())).encode()
+            try:
+                server.handle_request('/policyvalidate', body)
+            except RuntimeError:
+                pass
+            else:
+                raise AssertionError('handler exception must propagate')
+            assert registry.counter_value(
+                ADMISSION_REQUESTS, operation='CREATE',
+                allowed='error') == 1
+        finally:
+            set_global_registry(None)
