@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import List, Optional
 
 _T0 = time.time()
 
@@ -761,6 +762,20 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     adm_concurrency = admission_concurrency(adm_ctx, sieve_pods)
     adm_ctx[1].shutdown()
 
+    # rescan churn block (CI-sized; the O(churn) verdict-cache claim —
+    # full scale runs standalone via `bench.py --churn-ticks`)
+    rescan_block = None
+    if os.environ.get('BENCH_RESCAN', '1') == '1':
+        _progress('rescan churn bench')
+        try:
+            rescan_block = run_rescan_churn(
+                platform,
+                n=min(n_done, int(os.environ.get('BENCH_RESCAN_N',
+                                                 '20000'))),
+                ticks=3)
+        except Exception as e:  # noqa: BLE001 - block is additive
+            rescan_block = {'error': f'{type(e).__name__}: {e}'}
+
     # fresh-process warm time with the persistent compilation cache
     _progress('fresh-process cache probe')
     cache_warm_s = cache_probe(platform) \
@@ -802,6 +817,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'admission_n_policies': lat_n_policies,
         'admission_device_served': adm_device,
         'admission_concurrency': adm_concurrency,
+        'rescan': rescan_block,
     }
     if warning:
         result['warning'] = warning
@@ -951,6 +967,165 @@ def admission_concurrency(ctx, resources, thread_counts=None,
     return blocks
 
 
+# --------------------------------------------------------------------------
+# Rescan churn bench: the O(churn) claim for the digest-keyed verdict
+# cache (kyverno_tpu/verdictcache/).  Steady state: every tick demands a
+# full report rebuild over N rows of which only churn_ratio changed —
+# rows scanned per tick must track the churn, not N.
+
+
+class _NullReportClient:
+    """Report sink for the churn bench: reconcile's cost should be the
+    scan + cache work, not FakeClient CR bookkeeping over 100k rows."""
+
+    def get_resource(self, *a, **k):
+        raise KeyError('null client')
+
+    def create_resource(self, api_version, kind, ns, obj):
+        return obj
+
+    def update_resource(self, api_version, kind, ns, obj):
+        return obj
+
+    def delete_resource(self, *a, **k):
+        return None
+
+    def list_resource(self, *a, **k):
+        raise KeyError('null client')
+
+
+def _churn_controller(policies, resources, cache_dir, enabled):
+    from kyverno_tpu.reports.controllers import (BackgroundScanController,
+                                                 MetadataCache)
+    saved = {k: os.environ.get(k)
+             for k in ('KTPU_VERDICT_CACHE', 'KTPU_VERDICT_CACHE_DIR')}
+    os.environ['KTPU_VERDICT_CACHE'] = '1' if enabled else '0'
+    os.environ['KTPU_VERDICT_CACHE_DIR'] = cache_dir
+    try:
+        ctrl = BackgroundScanController(_NullReportClient(), policies,
+                                        cache=MetadataCache())
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for r in resources:
+        ctrl.cache.update(r)
+    return ctrl
+
+
+def run_rescan_churn(platform: str, n: Optional[int] = None,
+                     ticks: Optional[int] = None,
+                     ratio: Optional[float] = None) -> dict:
+    """N-row steady state with ``ratio`` mutation per tick: every tick
+    forgets resumability (the restart/report-rebuild demand), enqueues
+    all N rows, and reconciles — the verdict cache replays unchanged
+    rows and ships only changed digests to the device.  The dense
+    baseline (``KTPU_VERDICT_CACHE=0``) scans all N rows per tick."""
+    import random
+    import statistics
+    import tempfile
+
+    n = int(os.environ.get('BENCH_RESCAN_N', '100000')) if n is None else n
+    ticks = 5 if ticks is None else ticks
+    ratio = 0.01 if ratio is None else ratio
+    dense_ticks = min(ticks, int(os.environ.get(
+        'BENCH_RESCAN_DENSE_TICKS', '1')))
+    policies = load_policy_pack()
+    rng = random.Random(23)
+    resources = [make_pod(rng, i) for i in range(n)]
+    cache_dir = tempfile.mkdtemp(prefix='ktpu-vcache-')
+
+    def mutate(ctrl, tick):
+        idx = rng.sample(range(n), max(1, int(n * ratio)))
+        for i in idx:
+            resources[i]['spec']['containers'][0]['image'] = \
+                f'registry/churn:{tick}-{i}'
+            ctrl.cache.update(resources[i])
+        return len(idx)
+
+    def run_ticks(ctrl, count):
+        lat, scanned, replayed = [], [], []
+        for t in range(count):
+            mutate(ctrl, t)
+            ctrl.reset_scan_state()
+            ctrl.enqueue_all()
+            t0 = time.time()
+            ctrl.reconcile()
+            lat.append(time.time() - t0)
+            scanned.append(ctrl.rescan_stats['rows_scanned'])
+            replayed.append(ctrl.rescan_stats['rows_replayed'])
+            _progress(f'rescan tick {t}: scanned '
+                      f'{scanned[-1]}/{scanned[-1] + replayed[-1]} rows '
+                      f'in {lat[-1]:.2f}s')
+        return lat, scanned, replayed
+
+    def pctile(values, q):
+        s = sorted(values)
+        return round(s[min(len(s) - 1, int(len(s) * q))], 3)
+
+    _progress(f'rescan churn bench: {n} rows, {ticks} ticks @ {ratio}')
+    ctrl = _churn_controller(policies, resources, cache_dir, enabled=True)
+    t0 = time.time()
+    ctrl.enqueue_all()
+    ctrl.reconcile()  # cold tick: populate the cache
+    cold_s = time.time() - t0
+    lat, scanned, replayed = run_ticks(ctrl, ticks)
+    total = [s + r for s, r in zip(scanned, replayed)]
+    scanned_ratio = sum(scanned) / max(sum(total), 1)
+
+    _progress(f'rescan dense baseline: {dense_ticks} tick(s)')
+    dense = _churn_controller(policies, resources, cache_dir,
+                              enabled=False)
+    dense.enqueue_all()
+    dense.reconcile()  # cold tick: warm jit shapes like the cached run
+    dense_lat, _ds, _dr = run_ticks(dense, dense_ticks)
+
+    block = {
+        'n_rows': n, 'churn_ticks': ticks, 'churn_ratio': ratio,
+        'platform': platform,
+        'rows_scanned_per_tick': scanned,
+        'rows_replayed_per_tick': replayed,
+        'scanned_rows_ratio': round(scanned_ratio, 4),
+        'tick_p50_s': pctile(lat, 0.50),
+        'tick_p95_s': pctile(lat, 0.95),
+        'cold_tick_s': round(cold_s, 2),
+        'dense_tick_p50_s': pctile(dense_lat, 0.50),
+        'speedup_vs_dense': round(
+            statistics.median(dense_lat) / max(statistics.median(lat),
+                                               1e-9), 2),
+        'cache': dict(ctrl.verdict_cache.stats())
+        if ctrl.verdict_cache is not None else None,
+    }
+    from kyverno_tpu.observability import device as device_telemetry
+    reg = device_telemetry.registry()
+    if reg is not None:
+        from kyverno_tpu.verdictcache import (VERDICT_CACHE_EVICTIONS,
+                                              VERDICT_CACHE_HITS,
+                                              VERDICT_CACHE_MISSES)
+        block['hits'] = int(reg.counter_value(VERDICT_CACHE_HITS))
+        block['misses'] = int(reg.counter_value(VERDICT_CACHE_MISSES))
+        block['evictions'] = int(reg.counter_value(VERDICT_CACHE_EVICTIONS))
+    return block
+
+
+def rescan_churn_main(platform: str, args: List[str]) -> int:
+    """``bench.py --churn-ticks N [--churn-ratio R]``: run only the
+    rescan churn bench (full scale: BENCH_RESCAN_N rows, default
+    100k)."""
+    def flag(name, cast, default):
+        if name in args:
+            return cast(args[args.index(name) + 1])
+        return default
+    block = run_rescan_churn(platform,
+                             ticks=flag('--churn-ticks', int, 5),
+                             ratio=flag('--churn-ratio', float, 0.01))
+    print(json.dumps({'metric': 'rescan_churn', 'platform': platform,
+                      'rescan': block}))
+    return 0
+
+
 def admission_concurrency_main(platform: str) -> int:
     """``bench.py --admission-concurrency``: run only the
     concurrent-admission serving block (CI-sized; scale the policy set
@@ -996,6 +1171,13 @@ def main() -> int:
     if jsonl_path:
         _tracing.configure(memory=False, jsonl_path=jsonl_path)
     reg = device_telemetry.configure()
+    # the verdict cache (and the AOT store gauges) emit through the
+    # process-global registry the daemons wire in cmd/internal.Setup —
+    # point it at the bench registry so those series land in the blocks
+    from kyverno_tpu.observability.metrics import (global_registry,
+                                                   set_global_registry)
+    if global_registry() is None:
+        set_global_registry(reg)
     # device-coverage ledger: the `coverage` block below tracks how much
     # of the measured traffic actually ran on device (and why the rest
     # fell back) alongside the latency numbers
@@ -1008,6 +1190,16 @@ def main() -> int:
             traceback.print_exc()
             print(json.dumps({
                 'metric': 'admission_concurrency', 'platform': platform,
+                'error': f'{type(e).__name__}: {e}'}))
+            return 1
+    if '--churn-ticks' in sys.argv[1:] or '--churn-ratio' in sys.argv[1:]:
+        try:
+            return rescan_churn_main(platform, sys.argv[1:])
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': 'rescan_churn', 'platform': platform,
                 'error': f'{type(e).__name__}: {e}'}))
             return 1
     # BENCH_CONFIG=4|5 runs the scaled BASELINE configs; default is the

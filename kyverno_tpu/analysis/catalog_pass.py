@@ -12,6 +12,12 @@ and exit codes are unchanged).
 * **KTPU503** — dead metric: a cataloged name with no write site in
   the tree (``DEAD_METRIC_ALLOWLIST`` names the deliberate
   exceptions, each with the reason it may exist without an emitter).
+  The allowlist is itself checked both ways: an entry whose metric
+  *gained* a write site is stale (the exception no longer excuses
+  anything — remove it so the metric is catalog-checked like every
+  other), and an entry naming a metric absent from the catalog is
+  dead weight.  New subsystems therefore can't hide behind the
+  allowlist: the moment their emitter lands, only the catalog rules.
 """
 
 from __future__ import annotations
@@ -121,16 +127,30 @@ def _check_unresolved(ctx: Context) -> Iterable[Finding]:
             f'({desc}) — uncheckable, use a constant')
 
 
+def stale_allowlist_entries(catalog, used) -> List[Tuple[str, str]]:
+    """(name, problem) per DEAD_METRIC_ALLOWLIST entry that no longer
+    excuses anything: the metric gained a write site (the common case
+    when a reserved metric's subsystem finally lands) or fell out of
+    the catalog entirely."""
+    out: List[Tuple[str, str]] = []
+    for name in sorted(DEAD_METRIC_ALLOWLIST):
+        if name not in catalog:
+            out.append((name, 'names a metric absent from the catalog'))
+        elif name in used:
+            out.append((name, 'has a write site now — the metric is '
+                              'catalog-checked like any other'))
+    return out
+
+
 @register('KTPU503', 'dead metric: cataloged name with no write site '
-                     'in the tree')
+                     'in the tree (or stale allowlist entry)')
 def _check_dead_metrics(ctx: Context) -> Iterable[Finding]:
     catalog = load_catalog()
     resolved, _unresolved = collect_from_files(ctx.files)
     used = {name for _sf, _l, name in resolved}
     anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
-    for name in sorted(catalog):
-        if name in used or name in DEAD_METRIC_ALLOWLIST:
-            continue
+
+    def locate(name):
         target = anchor if anchor is not None else ctx.files[0]
         line = 1
         if anchor is not None:
@@ -138,11 +158,23 @@ def _check_dead_metrics(ctx: Context) -> Iterable[Finding]:
                 if f"'{name}'" in text:
                     line = i
                     break
+        return target, line
+
+    for name in sorted(catalog):
+        if name in used or name in DEAD_METRIC_ALLOWLIST:
+            continue
+        target, line = locate(name)
         yield target.finding(
             'KTPU503', line,
             f'catalog: {name} has no write site in the tree — remove '
             f'the entry, add the emitter, or allowlist it with a '
             f'reason (DEAD_METRIC_ALLOWLIST)')
+    for name, problem in stale_allowlist_entries(catalog, used):
+        target, line = locate(name)
+        yield target.finding(
+            'KTPU503', line,
+            f'DEAD_METRIC_ALLOWLIST: {name} {problem} — drop the '
+            f'stale allowlist entry')
 
 
 # -- standalone API for the scripts/check_metric_names.py shim ---------------
@@ -192,6 +224,9 @@ def check_main() -> int:
                 f'catalog: {name} has no write site in the tree — '
                 f'remove the entry, add the emitter, or allowlist it '
                 f'with a reason (DEAD_METRIC_ALLOWLIST)')
+    for name, problem in stale_allowlist_entries(catalog, used):
+        errors.append(f'DEAD_METRIC_ALLOWLIST: {name} {problem} — '
+                      f'drop the stale allowlist entry')
     if not resolved:
         errors.append('no metric call sites found — checker is broken')
     if errors:

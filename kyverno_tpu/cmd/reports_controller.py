@@ -27,6 +27,9 @@ class ReportsController:
         self.admission_controller = AdmissionReportController(setup.client)
         self.aggregate_controller = AggregateController(setup.client)
         self._policy_snapshot = None
+        # persist the verdict cache on shutdown so the next process
+        # restarts its background rescans at O(churn), not O(cluster)
+        setup.register_shutdown(self.scan_controller.close)
 
     def _policies(self) -> List[Policy]:
         docs = []

@@ -75,6 +75,23 @@ METRICS: Dict[str, Metric] = {
         'counter', 'Requests shed from the batched fast path to the '
         'host engine loop, by reason=queue_full|deadline|scan_error|'
         'shutdown (never a 500).'),
+    # verdict cache + incremental rescans (verdictcache/)
+    'kyverno_tpu_verdict_cache_hits_total': Metric(
+        'counter', 'Background-rescan rows replayed from the '
+        'digest-keyed verdict cache instead of re-scanning.'),
+    'kyverno_tpu_verdict_cache_misses_total': Metric(
+        'counter', 'Verdict-cache lookups that missed (changed or '
+        'never-seen spec digest) and shipped to the dense scan.'),
+    'kyverno_tpu_verdict_cache_evictions_total': Metric(
+        'counter', 'Verdict rows dropped by the memory-LRU entry cap '
+        'or generation snapshots dropped by the disk byte budget '
+        '(KTPU_VERDICT_CACHE_MAX).'),
+    'kyverno_tpu_rescan_rows_scanned': Metric(
+        'gauge', 'Rows the most recent background reconcile evaluated '
+        'on the dense device path.'),
+    'kyverno_tpu_rescan_rows_replayed': Metric(
+        'gauge', 'Rows the most recent background reconcile replayed '
+        'from the verdict cache.'),
     # AOT cache + warm-up instruments (aotcache/)
     'kyverno_tpu_aot_warm_duration_seconds': Metric(
         'histogram', 'Background warm-up wall time by target/state '

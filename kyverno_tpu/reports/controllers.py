@@ -20,6 +20,7 @@ from ..api.policy import Policy
 from ..api.unstructured import Resource
 from ..compiler.scan import BatchScanner
 from ..engine.engine import Engine
+from ..verdictcache.keys import spec_digest
 from .results import set_responses
 from .types import (calculate_resource_hash, new_background_scan_report,
                     set_managed_by_kyverno_label,
@@ -32,11 +33,26 @@ class MetadataCache:
     """Resource-metadata cache keyed by uid
     (reference: pkg/controllers/report/resource/controller.go
     MetadataCache): tracks the resource versions/hashes the scanner uses
-    for invalidation."""
+    for invalidation.  ``add_invalidator`` registers uid-keyed hooks the
+    cache calls on every content change or delete — the watch/
+    resourceVersion delta the verdict cache rides for free."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
+        self._invalidators: List[Callable[[str], Any]] = []
+
+    def add_invalidator(self, fn: Callable[[str], Any]) -> None:
+        """``fn(uid)`` runs (outside the cache lock) whenever a
+        resource's hash changes or the resource is removed."""
+        self._invalidators.append(fn)
+
+    def _invalidate(self, uid: str) -> None:
+        for fn in self._invalidators:
+            try:
+                fn(uid)
+            except Exception:  # noqa: BLE001 - hooks must not break sync
+                pass
 
     def update(self, resource: dict) -> bool:
         """Record a resource; returns True when its hash changed."""
@@ -53,16 +69,26 @@ class MetadataCache:
                 'namespace': meta.get('namespace', ''),
                 'name': meta.get('name', ''),
                 'hash': h,
+                # verdict-cache key, computed once per content change
+                # instead of once per reconcile tick over every row
+                'digest': spec_digest(resource),
                 'resource': resource,
             }
-        return old is None or old['hash'] != h
+        changed = old is None or old['hash'] != h
+        if changed and old is not None:
+            self._invalidate(uid)
+        return changed
 
     def remove(self, resource: dict) -> None:
+        """Forget a deleted resource — and drop its verdict-cache rows
+        via the invalidators, so a recreated resource with a stale uid
+        can never replay old verdicts."""
         meta = resource.get('metadata') or {}
         uid = meta.get('uid') or f"{resource.get('kind')}/" \
             f"{meta.get('namespace', '')}/{meta.get('name', '')}"
         with self._lock:
             self._entries.pop(uid, None)
+        self._invalidate(uid)
 
     def entries(self) -> List[dict]:
         with self._lock:
@@ -133,15 +159,58 @@ class BackgroundScanController:
         self._pending: Set[str] = set()
         self._scanned: Dict[str, Tuple[str, float]] = {}  # uid → (hash, ts)
         self._policy_epoch = 0.0
+        self.verdict_cache = None
+        #: per-reconcile rescan accounting (mirrors the
+        #: kyverno_tpu_rescan_rows_* gauges for in-process readers)
+        self.rescan_stats: Dict[str, int] = {
+            'rows_pending': 0, 'rows_scanned': 0, 'rows_replayed': 0}
         self.set_policies(policies)
+        # verdict-cache invalidation rides the metadata cache's
+        # resourceVersion/delete deltas for free
+        self.cache.add_invalidator(self._drop_verdicts)
 
     def set_policies(self, policies: List[Policy]) -> None:
         """Policy change invalidates every prior scan
-        (reference: controller.go re-enqueues on policy events)."""
+        (reference: controller.go re-enqueues on policy events).  The
+        verdict cache flushes by fingerprint: a changed policy set opens
+        a new cache generation, so stale rows can never replay."""
+        from ..aotcache import policy_set_fingerprint
+        from ..verdictcache import VerdictCache
         self.policies = policies
         self.scanner = BatchScanner(policies, engine=self.engine)
+        self._policy_index = {id(p): i for i, p in enumerate(policies)}
+        # rows are only cacheable when every contributing result is a
+        # pure function of (resource, policy set): host-riding policies
+        # and context-loading rules consult external state per tick, so
+        # their rows must re-evaluate on the dense path every time
+        self._verdicts_cacheable = (
+            not self.scanner._host_policy_idx and
+            all(p.context_spec is None for p in self.scanner.cps.programs))
+        old_cache = self.verdict_cache
+        if old_cache is not None:
+            old_cache.flush()
+        self._policy_fingerprint = policy_set_fingerprint(policies)
+        self.verdict_cache = VerdictCache.from_env(self._policy_fingerprint)
         with self._lock:
             self._policy_epoch = time.time()
+
+    def _drop_verdicts(self, uid: str) -> None:
+        vc = self.verdict_cache
+        if vc is not None:
+            vc.invalidate_uid(uid)
+
+    def reset_scan_state(self) -> None:
+        """Forget per-process resumability: the next reconcile rebuilds
+        every enqueued resource's report (what a process restart or a
+        report-repair pass demands).  With a warm verdict cache that
+        full demand stays O(churn) — unchanged rows replay."""
+        self._scanned.clear()
+
+    def close(self) -> None:
+        """Persist the verdict cache (daemon shutdown hook)."""
+        vc = self.verdict_cache
+        if vc is not None:
+            vc.flush()
 
     def enqueue(self, resource: dict) -> None:
         self.cache.update(resource)
@@ -155,16 +224,20 @@ class BackgroundScanController:
         with self._lock:
             self._pending.update(e['uid'] for e in self.cache.entries())
 
-    def reconcile(self) -> List[dict]:
-        """Drain the pending set through one batched device scan and
-        write BackgroundScanReport CRs; unchanged resources scanned
-        after the last policy change are skipped."""
+    def reconcile(self, now: Optional[float] = None) -> List[dict]:
+        """Drain the pending set through the verdict-cache filter and
+        one batched device scan of the misses, writing
+        BackgroundScanReport CRs; unchanged resources scanned after the
+        last policy change are skipped.  ``now`` pins the scan
+        timestamp (tests use it for bit-identity comparisons)."""
         with self._lock:
             pending = list(self._pending)
             self._pending.clear()
             epoch = self._policy_epoch
         work: List[dict] = []
         uids: List[str] = []
+        hashes: List[str] = []   # metadata-cache hashes, reused below
+        digests: List[str] = []  # verdict-cache keys, ditto
         for uid in pending:
             entry = self.cache.get(uid)
             if entry is None:
@@ -175,37 +248,102 @@ class BackgroundScanController:
                 continue  # resumability: already scanned this version
             work.append(entry['resource'])
             uids.append(uid)
+            hashes.append(entry['hash'])
+            digests.append(entry.get('digest') or
+                           spec_digest(entry['resource']))
         if not work:
             return []
-        now = time.time()
-        # stream: report construction + CR writes overlap the next
-        # chunk's encode/transfer/device stages.  PolicyExceptions are
-        # rare and rule-targeted; when any exist the host engine decides
-        # (exception semantics: pkg/engine/validation.go:826
-        # hasPolicyExceptions — the compiled path has no exception lanes)
+        now = time.time() if now is None else now
+        from ..observability import tracing
+        from ..verdictcache import publish_tick
+        # PolicyExceptions are rare and rule-targeted; when any exist
+        # the host engine decides (exception semantics:
+        # pkg/engine/validation.go:826 hasPolicyExceptions — the
+        # compiled path has no exception lanes) and rows are
+        # exception-dependent, so the verdict cache stands aside
         exceptions = self._list_exceptions()
-        reports = []
-        if exceptions:
-            stream = self._host_scan(work, exceptions)
-            for uid, resource, responses in zip(uids, work, stream):
-                report = self._store_report(uid, resource, responses, now)
-                self._scanned[uid] = (calculate_resource_hash(resource),
-                                      now)
-                if report is not None:
-                    reports.append(report)
-            return reports
-        # fused fast path: report results assembled straight from the
-        # device cells (bit-identity pinned by tests/test_report_fusion)
-        for uid, resource, row in zip(
-                uids, work, self.scanner.scan_report_results(work, now)):
-            report = self._store_fused_report(uid, resource, row, now)
-            self._scanned[uid] = (calculate_resource_hash(resource), now)
-            if report is not None:
-                reports.append(report)
+        vc = self.verdict_cache \
+            if self._verdicts_cacheable and not exceptions else None
+        reports: List[dict] = []
+        with tracing.start_span('kyverno/rescan', {
+                'rows_pending': len(work),
+                'cache': 'on' if vc is not None else 'off'}) as span:
+            if exceptions:
+                stream = self._host_scan(work, exceptions)
+                for uid, resource, rhash, responses in zip(
+                        uids, work, hashes, stream):
+                    report = self._store_report(uid, resource, responses,
+                                                now, rhash)
+                    self._scanned[uid] = (rhash, now)
+                    if report is not None:
+                        reports.append(report)
+                self._tick_stats(span, publish_tick, len(work),
+                                 scanned=len(work), replayed=0)
+                return reports
+            # verdict-cache filter stage: replay hit rows in O(1),
+            # ship only changed digests to the device
+            ts = int(now)
+            miss_uids: List[str] = []
+            miss_work: List[dict] = []
+            miss_digests: List[str] = []
+            miss_hashes: List[str] = []
+            replayed = 0
+            if vc is not None:
+                for uid, resource, rhash, digest in zip(
+                        uids, work, hashes, digests):
+                    row = vc.lookup(digest)
+                    if row is None:
+                        miss_uids.append(uid)
+                        miss_work.append(resource)
+                        miss_digests.append(digest)
+                        miss_hashes.append(rhash)
+                        continue
+                    report = self._store_fused_report(
+                        uid, resource, vc.replay(row, self.policies, ts),
+                        now, rhash)
+                    self._scanned[uid] = (rhash, now)
+                    if report is not None:
+                        reports.append(report)
+                    replayed += 1
+            else:
+                miss_uids, miss_work, miss_hashes = uids, work, hashes
+                miss_digests = [''] * len(work)
+            # fused fast path over the misses: report results assembled
+            # straight from the device cells (bit-identity pinned by
+            # tests/test_report_fusion), rows written back to the cache
+            if miss_work:
+                for uid, resource, digest, rhash, row in zip(
+                        miss_uids, miss_work, miss_digests, miss_hashes,
+                        self.scanner.scan_report_results(miss_work, now)):
+                    report = self._store_fused_report(uid, resource, row,
+                                                      now, rhash)
+                    self._scanned[uid] = (rhash, now)
+                    if report is not None:
+                        reports.append(report)
+                    if vc is not None:
+                        results, summary, row_policies = row
+                        vc.store(digest, uid, results, summary,
+                                 [self._policy_index[id(p)]
+                                  for p in row_policies])
+            self._tick_stats(span, publish_tick, len(work),
+                             scanned=len(miss_work), replayed=replayed)
+        if vc is not None:
+            vc.flush()
         return reports
 
+    def _tick_stats(self, span, publish_tick, pending: int, scanned: int,
+                    replayed: int) -> None:
+        self.rescan_stats = {'rows_pending': pending,
+                             'rows_scanned': scanned,
+                             'rows_replayed': replayed}
+        span.set_attribute('rows_scanned', scanned)
+        span.set_attribute('rows_replayed', replayed)
+        publish_tick(scanned, replayed)
+
     def _store_fused_report(self, uid: str, resource: dict, row,
-                            now: float) -> Optional[dict]:
+                            now: float,
+                            resource_hash: Optional[str] = None
+                            ) -> Optional[dict]:
         from .results import set_fused_results
         results, summary, row_policies = row
         meta = resource.get('metadata') or {}
@@ -213,7 +351,7 @@ class BackgroundScanController:
         report = new_background_scan_report(resource)
         if not report['metadata'].get('name'):
             report['metadata']['name'] = uid.replace('/', '-').lower()
-        set_resource_version_labels(report, resource)
+        set_resource_version_labels(report, resource, resource_hash)
         report.setdefault('metadata', {}).setdefault('annotations', {})[
             ANNOTATION_LAST_SCAN_TIME] = _rfc3339(now)
         set_fused_results(report, results, summary, row_policies)
@@ -273,13 +411,14 @@ class BackgroundScanController:
             yield responses
 
     def _store_report(self, uid: str, resource: dict, responses,
-                      now: float) -> Optional[dict]:
+                      now: float, resource_hash: Optional[str] = None
+                      ) -> Optional[dict]:
         meta = resource.get('metadata') or {}
         ns = meta.get('namespace', '')
         report = new_background_scan_report(resource)
         if not report['metadata'].get('name'):
             report['metadata']['name'] = uid.replace('/', '-').lower()
-        set_resource_version_labels(report, resource)
+        set_resource_version_labels(report, resource, resource_hash)
         # the scan timestamp annotation drives resumability
         # (reference: controller.go:44 audit.kyverno.io/last-scan-time)
         report.setdefault('metadata', {}).setdefault('annotations', {})[

@@ -4,7 +4,6 @@ api/kyverno/v1alpha2, api/policyreport/v1alpha2).
 
 from __future__ import annotations
 
-import copy
 import hashlib
 import json
 from typing import List, Optional
@@ -85,25 +84,30 @@ def set_resource_labels(report: dict, uid: str) -> None:
 
 def calculate_resource_hash(resource: dict) -> str:
     """reference: labels.go:73 CalculateResourceHash — md5 over
-    [labels, annotations, object minus metadata/status/scale/nodeName]."""
-    obj = copy.deepcopy(resource)
-    meta = obj.get('metadata') or {}
-    labels = meta.get('labels')
-    annotations = meta.get('annotations')
-    obj.pop('metadata', None)
-    obj.pop('status', None)
-    obj.pop('scale', None)
-    if isinstance(obj.get('spec'), dict):
-        obj['spec'].pop('nodeName', None)
-    data = json.dumps([labels, annotations, obj], separators=(',', ':'),
-                      sort_keys=True)
+    [labels, annotations, object minus metadata/status/scale/nodeName].
+    Shallow-copies only the containers it prunes (json.dumps never
+    mutates): the old deepcopy dominated background-reconcile ticks at
+    two calls per row."""
+    meta = resource.get('metadata') or {}
+    obj = {k: v for k, v in resource.items()
+           if k not in ('metadata', 'status', 'scale')}
+    spec = obj.get('spec')
+    if isinstance(spec, dict) and 'nodeName' in spec:
+        obj['spec'] = {k: v for k, v in spec.items() if k != 'nodeName'}
+    data = json.dumps([meta.get('labels'), meta.get('annotations'), obj],
+                      separators=(',', ':'), sort_keys=True)
     return hashlib.md5(data.encode()).hexdigest()  # noqa: S324 — parity
 
 
-def set_resource_version_labels(report: dict,
-                                resource: Optional[dict]) -> None:
-    _set_label(report, LABEL_RESOURCE_HASH,
-               calculate_resource_hash(resource) if resource else '')
+def set_resource_version_labels(report: dict, resource: Optional[dict],
+                                resource_hash: Optional[str] = None
+                                ) -> None:
+    """``resource_hash`` short-circuits the hash when the caller already
+    holds it (the metadata cache computes it on every update)."""
+    if resource_hash is None:
+        resource_hash = calculate_resource_hash(resource) if resource \
+            else ''
+    _set_label(report, LABEL_RESOURCE_HASH, resource_hash)
 
 
 def _owner_reference(resource: dict) -> dict:

@@ -294,12 +294,31 @@ def test_ktpu502_positive_negative(tmp_path):
 
 
 def test_ktpu503_positive_negative(tmp_path):
+    from kyverno_tpu.analysis.catalog_pass import DEAD_METRIC_ALLOWLIST
     rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU503'])
     assert rule_ids(rep) == {'KTPU503'}
+    # a write site for every non-allowlisted metric is the clean state
+    # (an allowlisted metric with a write site is a *stale* allowlist
+    # entry — covered below)
+    writes = 'def emit(reg):\n' + ''.join(
+        f"    reg.inc('{name}')\n" for name in sorted(METRICS)
+        if name not in DEAD_METRIC_ALLOWLIST)
+    rep = run(tmp_path, {'a.py': writes}, rules=['KTPU503'])
+    assert not rep.active
+
+
+def test_ktpu503_stale_allowlist_entry(tmp_path):
+    """An allowlist entry whose metric gained a write site is itself a
+    finding — the allowlist stays minimal by construction, and newly
+    landed subsystems can't hide behind it."""
+    from kyverno_tpu.analysis.catalog_pass import DEAD_METRIC_ALLOWLIST
+    allowlisted = sorted(DEAD_METRIC_ALLOWLIST)[0]
     writes = 'def emit(reg):\n' + ''.join(
         f"    reg.inc('{name}')\n" for name in sorted(METRICS))
     rep = run(tmp_path, {'a.py': writes}, rules=['KTPU503'])
-    assert not rep.active
+    assert rule_ids(rep) == {'KTPU503'}
+    assert any(allowlisted in f.message and 'stale' in f.message
+               for f in rep.active)
 
 
 # -- KTPU00x: suppression hygiene (meta rules) -------------------------------

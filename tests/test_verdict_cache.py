@@ -1,0 +1,353 @@
+"""Digest-keyed verdict cache (ISSUE 6): spec-digest stability, store
+hit/miss/invalidation/eviction semantics, controller integration
+(replay vs scan partition, delete invalidation, policy-set flush), the
+KTPU_VERDICT_CACHE=off bit-identity oracle, and second-process
+disk-store reuse."""
+
+import os
+import sys
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from kyverno_tpu.api.policy import Policy  # noqa: E402
+from kyverno_tpu.dclient.client import FakeClient  # noqa: E402
+from kyverno_tpu.observability.metrics import (MetricsRegistry,  # noqa: E402
+                                               set_global_registry)
+from kyverno_tpu.reports.controllers import (  # noqa: E402
+    BackgroundScanController, MetadataCache)
+from kyverno_tpu.verdictcache import (VerdictCache, engine_rev,  # noqa: E402
+                                      generation_key, spec_digest)
+
+POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: audit
+  rules:
+    - name: team-label
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: team label required
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+""")
+
+OTHER_POLICY = yaml.safe_load("""
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-owner
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: audit
+  rules:
+    - name: owner-label
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: owner label required
+        pattern:
+          metadata:
+            labels:
+              owner: "?*"
+""")
+
+NOW = 1754000000.0
+
+
+def pod(name, team=None, uid=None):
+    labels = {'team': team} if team else {}
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'uid': uid or f'uid-{name}', 'labels': labels},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+@pytest.fixture(autouse=True)
+def _registry():
+    reg = MetricsRegistry()
+    set_global_registry(reg)
+    yield reg
+    set_global_registry(None)
+
+
+def make_ctrl(tmp_path, monkeypatch, enabled=True, policies=None,
+              client=None):
+    monkeypatch.setenv('KTPU_VERDICT_CACHE', '1' if enabled else '0')
+    monkeypatch.setenv('KTPU_VERDICT_CACHE_DIR', str(tmp_path / 'vc'))
+    return BackgroundScanController(
+        client or FakeClient(),
+        [Policy(p) for p in (policies or [POLICY])], cache=MetadataCache())
+
+
+def reports_of(ctrl):
+    """Stored reports with the fake API server's own write bookkeeping
+    (metadata.resourceVersion bumps per update, server-assigned
+    metadata.uid) normalized away — the bit-identity contract is about
+    report *content*."""
+    out = []
+    for r in sorted(ctrl.client.list_resource(
+            'kyverno.io/v1alpha2', 'BackgroundScanReport', 'default',
+            None), key=lambda r: r['metadata']['name']):
+        r = dict(r, metadata={k: v for k, v in r['metadata'].items()
+                              if k not in ('resourceVersion', 'uid')})
+        out.append(r)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# spec digest
+
+
+class TestSpecDigest:
+    def test_key_order_and_volatile_metadata_irrelevant(self):
+        a = pod('p', team='infra')
+        # same content, different key order + server-side bookkeeping
+        b = {
+            'kind': 'Pod', 'apiVersion': 'v1',
+            'spec': {'containers': [{'image': 'nginx', 'name': 'c'}]},
+            'metadata': {
+                'labels': {'team': 'infra'}, 'uid': 'uid-p',
+                'namespace': 'default', 'name': 'p',
+                'resourceVersion': '123456',
+                'generation': 7,
+                'creationTimestamp': '2026-01-01T00:00:00Z',
+                'managedFields': [{'manager': 'kubectl',
+                                   'operation': 'Apply'}],
+            },
+        }
+        assert spec_digest(a) == spec_digest(b)
+
+    def test_changed_content_misses(self):
+        base = pod('p', team='infra')
+        changed = pod('p', team='other')
+        assert spec_digest(base) != spec_digest(changed)
+        with_status = pod('p', team='infra')
+        with_status['status'] = {'phase': 'Running'}
+        assert spec_digest(base) != spec_digest(with_status)
+
+    def test_recreated_uid_misses(self):
+        # a deleted-then-recreated resource gets a fresh uid, so even
+        # identical content never aliases the predecessor's entries
+        assert spec_digest(pod('p', uid='u1')) != \
+            spec_digest(pod('p', uid='u2'))
+
+    def test_digest_does_not_mutate_the_resource(self):
+        p = pod('p')
+        p['metadata']['resourceVersion'] = '42'
+        spec_digest(p)
+        assert p['metadata']['resourceVersion'] == '42'
+
+
+# ---------------------------------------------------------------------------
+# store
+
+
+ROW = ([{'source': 'kyverno', 'policy': 'require-team',
+         'rule': 'team-label', 'message': 'ok', 'result': 'pass',
+         'scored': True, 'timestamp': {'seconds': 1}}],
+       {'pass': 1, 'fail': 0, 'warn': 0, 'error': 0, 'skip': 0}, [0])
+
+
+class TestStore:
+    def test_hit_miss_and_replay_stamps_timestamp(self, tmp_path,
+                                                  _registry):
+        vc = VerdictCache('fp', root=str(tmp_path))
+        assert vc.lookup('d1') is None
+        results, summary, idx = ROW
+        vc.store('d1', 'u1', results, summary, idx)
+        row = vc.lookup('d1')
+        assert row is not None
+        policies = [Policy(POLICY)]
+        r2, s2, p2 = vc.replay(row, policies, ts=99)
+        assert r2[0]['timestamp'] == {'seconds': 99}
+        assert {k: v for k, v in r2[0].items() if k != 'timestamp'} == \
+            {k: v for k, v in results[0].items() if k != 'timestamp'}
+        assert s2 == summary and p2 == policies
+        assert _registry.counter_value(
+            'kyverno_tpu_verdict_cache_hits_total') == 1.0
+        assert _registry.counter_value(
+            'kyverno_tpu_verdict_cache_misses_total') == 1.0
+
+    def test_uid_invalidation_drops_entries(self, tmp_path):
+        vc = VerdictCache('fp', root=str(tmp_path))
+        vc.store('d1', 'u1', *ROW)
+        vc.store('d2', 'u1', *ROW)
+        vc.store('d3', 'u2', *ROW)
+        assert vc.invalidate_uid('u1') == 2
+        assert vc.lookup('d1') is None and vc.lookup('d2') is None
+        assert vc.lookup('d3') is not None
+
+    def test_memory_lru_eviction_counts(self, tmp_path, _registry):
+        vc = VerdictCache('fp', root=str(tmp_path), max_entries=2)
+        vc.store('d1', 'u1', *ROW)
+        vc.store('d2', 'u2', *ROW)
+        vc.lookup('d1')  # refresh: d2 becomes LRU
+        vc.store('d3', 'u3', *ROW)
+        assert vc.lookup('d2') is None and vc.lookup('d1') is not None
+        assert _registry.counter_value(
+            'kyverno_tpu_verdict_cache_evictions_total') == 1.0
+
+    def test_snapshot_roundtrip_and_corruption(self, tmp_path):
+        vc = VerdictCache('fp', root=str(tmp_path))
+        vc.store('d1', 'u1', *ROW)
+        assert vc.flush()
+        assert not vc.flush()  # clean: nothing to write
+        again = VerdictCache('fp', root=str(tmp_path))
+        assert again.lookup('d1') is not None
+        assert again.invalidate_uid('u1') == 1  # uid index rebuilt
+        # a bit-flipped snapshot is dropped and loaded as empty
+        path = vc.path()
+        raw = bytearray(open(path, 'rb').read())
+        raw[-1] ^= 0xFF
+        open(path, 'wb').write(bytes(raw))
+        fresh = VerdictCache('fp', root=str(tmp_path))
+        assert len(fresh) == 0
+        assert not os.path.exists(path)
+
+    def test_generation_isolation_and_disk_eviction(self, tmp_path):
+        old = VerdictCache('fp-old', root=str(tmp_path), max_bytes=1)
+        old.store('d1', 'u1', *ROW)
+        old.flush()
+        # different fingerprint = different generation: no aliasing
+        new = VerdictCache('fp-new', root=str(tmp_path), max_bytes=1)
+        assert new.lookup('d1') is None
+        os.utime(old.path(), (1, 1))  # age the old generation
+        new.store('d1', 'u1', *ROW)
+        new.flush()  # budget of 1 byte: the old generation is evicted
+        assert not os.path.exists(old.path())
+        assert os.path.exists(new.path())
+
+    def test_engine_rev_scopes_generation(self, tmp_path, monkeypatch):
+        a = VerdictCache('fp', root=str(tmp_path), rev='rev-a')
+        a.store('d1', 'u1', *ROW)
+        a.flush()
+        b = VerdictCache('fp', root=str(tmp_path), rev='rev-b')
+        assert b.lookup('d1') is None  # code change never replays
+        assert generation_key('fp', 'rev-a') != generation_key(
+            'fp', 'rev-b')
+        assert engine_rev()  # derivable in this tree
+
+
+# ---------------------------------------------------------------------------
+# controller integration
+
+
+def seed(ctrl, pods):
+    for p in pods:
+        ctrl.enqueue(p)
+
+
+class TestControllerIntegration:
+    def test_warm_rescan_replays_without_scanning(self, tmp_path,
+                                                  monkeypatch):
+        ctrl = make_ctrl(tmp_path, monkeypatch)
+        pods = [pod('good', team='infra'), pod('bad')]
+        seed(ctrl, pods)
+        assert len(ctrl.reconcile(now=NOW)) == 2
+        assert ctrl.rescan_stats == {
+            'rows_pending': 2, 'rows_scanned': 2, 'rows_replayed': 0}
+        first = reports_of(ctrl)
+        # a full report-rebuild demand (restart semantics) replays from
+        # the cache — the device scanner must not run at all
+        monkeypatch.setattr(
+            ctrl.scanner, 'scan_report_results',
+            lambda *a, **k: pytest.fail('warm rescan must not scan'))
+        ctrl.reset_scan_state()
+        ctrl.enqueue_all()
+        assert len(ctrl.reconcile(now=NOW)) == 2
+        assert ctrl.rescan_stats == {
+            'rows_pending': 2, 'rows_scanned': 0, 'rows_replayed': 2}
+        assert reports_of(ctrl) == first
+
+    def test_churn_scans_only_changed_rows(self, tmp_path, monkeypatch,
+                                           _registry):
+        ctrl = make_ctrl(tmp_path, monkeypatch)
+        pods = [pod(f'p{i}', team='infra') for i in range(8)]
+        seed(ctrl, pods)
+        ctrl.reconcile(now=NOW)
+        pods[3]['metadata']['labels'] = {}  # churn one row
+        ctrl.cache.update(pods[3])
+        ctrl.reset_scan_state()
+        ctrl.enqueue_all()
+        ctrl.reconcile(now=NOW + 30)
+        assert ctrl.rescan_stats == {
+            'rows_pending': 8, 'rows_scanned': 1, 'rows_replayed': 7}
+        assert _registry.gauge_value(
+            'kyverno_tpu_rescan_rows_scanned') == 1.0
+        assert _registry.gauge_value(
+            'kyverno_tpu_rescan_rows_replayed') == 7.0
+        # the churned row's report reflects the new content
+        failed = [r for r in reports_of(ctrl)
+                  if r['metadata']['ownerReferences'][0]['name'] == 'p3']
+        assert failed[0]['spec']['summary']['fail'] == 1
+
+    def test_delete_drops_verdict_entries(self, tmp_path, monkeypatch):
+        ctrl = make_ctrl(tmp_path, monkeypatch)
+        p = pod('gone', team='infra')
+        seed(ctrl, [p])
+        ctrl.reconcile(now=NOW)
+        assert len(ctrl.verdict_cache) == 1
+        ctrl.cache.remove(p)
+        assert len(ctrl.verdict_cache) == 0
+
+    def test_policy_change_opens_new_generation(self, tmp_path,
+                                                monkeypatch):
+        ctrl = make_ctrl(tmp_path, monkeypatch)
+        seed(ctrl, [pod('p', team='infra')])
+        ctrl.reconcile(now=NOW)
+        gen_before = ctrl.verdict_cache.fingerprint
+        ctrl.set_policies([Policy(OTHER_POLICY)])
+        assert ctrl.verdict_cache.fingerprint != gen_before
+        ctrl.enqueue(pod('p', team='infra'))
+        ctrl.reconcile(now=NOW + 60)
+        assert ctrl.rescan_stats['rows_scanned'] == 1
+        assert ctrl.rescan_stats['rows_replayed'] == 0
+
+    def test_off_switch_bit_identical_reports(self, tmp_path,
+                                              monkeypatch):
+        """ISSUE 6 acceptance: cached-rescan output is pinned against a
+        fresh dense scan — KTPU_VERDICT_CACHE=off produces bit-identical
+        BackgroundScanReports for the same (resources, policies, now)."""
+        pods = [pod('good', team='infra'), pod('bad'), pod('mid')]
+        cached = make_ctrl(tmp_path, monkeypatch, enabled=True)
+        seed(cached, [pod('good', team='infra'), pod('bad'), pod('mid')])
+        cached.reconcile(now=NOW)       # populate the cache
+        cached.reset_scan_state()
+        seed(cached, pods)
+        cached.reconcile(now=NOW + 30)  # replayed pass
+        assert cached.rescan_stats['rows_replayed'] == 3
+        dense = make_ctrl(tmp_path / 'dense', monkeypatch, enabled=False)
+        assert dense.verdict_cache is None
+        seed(dense, [pod('good', team='infra'), pod('bad'), pod('mid')])
+        dense.reconcile(now=NOW + 30)
+        assert dense.rescan_stats['rows_replayed'] == 0
+        assert reports_of(cached) == reports_of(dense)
+
+    def test_second_process_disk_store_reuse(self, tmp_path,
+                                             monkeypatch):
+        """A fresh controller (new process: cold memory, same cache dir
+        and policy set) replays from the persisted snapshot with zero
+        device scans."""
+        first = make_ctrl(tmp_path, monkeypatch)
+        pods = [pod('a', team='x'), pod('b')]
+        seed(first, pods)
+        first.reconcile(now=NOW)
+        first.close()  # daemon-shutdown flush
+        second = make_ctrl(tmp_path, monkeypatch)
+        monkeypatch.setattr(
+            second.scanner, 'scan_report_results',
+            lambda *a, **k: pytest.fail('disk-warm rescan must not scan'))
+        seed(second, [pod('a', team='x'), pod('b')])
+        assert len(second.reconcile(now=NOW)) == 2
+        assert second.rescan_stats == {
+            'rows_pending': 2, 'rows_scanned': 0, 'rows_replayed': 2}
+        assert reports_of(second) == reports_of(first)
