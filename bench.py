@@ -915,19 +915,33 @@ def admission_concurrency(ctx, resources, thread_counts=None,
     — the micro-batcher coalesces their scans into shared device
     dispatches.  One block per thread count:
     ``{threads, decisions_per_s, batch_occupancy_p50,
-    queue_wait_p50_ms, shed_total}``."""
+    queue_wait_p50_ms, shed_total, decision_breakdown}`` — the
+    breakdown (per-path p50/p95 + device-share histogram from the
+    decision-provenance flight recorder) is the tracked number for the
+    homogeneous-vs-heterogeneous occupancy gap (ROADMAP)."""
     import threading
+    from kyverno_tpu.observability import provenance
     server, handlers, _n_replicated, device_served = ctx
     if thread_counts is None:
         spec = os.environ.get('BENCH_ADMISSION_THREADS', '1,8,32')
         thread_counts = [int(t) for t in spec.split(',') if t.strip()]
     prior_mode = handlers.serving_mode
     handlers.serving_mode = 'batch'
+    recorder = provenance.recorder()
+    prov_owned = recorder is None
+    if prov_owned:
+        # ring must hold every decision of the largest run so the
+        # one-record-per-decision invariant below is checkable
+        recorder = provenance.configure(
+            flight_n=max(16384,
+                         2 * max(thread_counts) * requests_per_thread))
     blocks = []
     try:
         for n_threads in thread_counts:
             batcher = handlers._get_batcher()
             batcher.reset_stats()
+            if recorder is not None:
+                recorder.reset()
             barrier = threading.Barrier(n_threads + 1)
 
             def work(tid, n_threads=n_threads):
@@ -949,6 +963,11 @@ def admission_concurrency(ctx, resources, thread_counts=None,
             elapsed = time.time() - t0
             stats = batcher.stats()
             decisions = n_threads * requests_per_thread
+            breakdown = provenance.breakdown()
+            if breakdown:
+                # provenance invariant: one DecisionRecord per decision
+                assert breakdown['decisions'] == decisions, \
+                    (breakdown['decisions'], decisions)
             blocks.append({
                 'threads': n_threads,
                 'decisions_per_s': round(decisions / elapsed, 1)
@@ -958,12 +977,15 @@ def admission_concurrency(ctx, resources, thread_counts=None,
                 'queue_wait_p50_ms': round(stats['queue_wait_p50_ms'], 3),
                 'shed_total': stats['shed_total'],
                 'device_served': device_served,
+                'decision_breakdown': breakdown,
             })
             _progress(f'admission concurrency: {n_threads} threads -> '
                       f"{blocks[-1]['decisions_per_s']}/s, occupancy "
                       f"p50 {blocks[-1]['batch_occupancy_p50']}")
     finally:
         handlers.serving_mode = prior_mode
+        if prov_owned:
+            provenance.disable()
     return blocks
 
 

@@ -1,7 +1,7 @@
-"""Metric-catalog passes (KTPU5xx) — the framework home of what
-``scripts/check_metric_names.py`` used to do standalone (the script is
-now a thin shim over this module; its allowlist semantics, module API,
-and exit codes are unchanged).
+"""Metric- and span-catalog passes (KTPU5xx) — the framework home of
+what ``scripts/check_metric_names.py`` used to do standalone (the
+script is now a thin shim over this module; its allowlist semantics,
+module API, and exit codes are unchanged).
 
 * **KTPU501** — a registry write (``inc`` / ``observe`` / ``set_gauge``
   / ``clear_gauge`` / ``register_histogram``) uses a metric name absent
@@ -18,6 +18,16 @@ and exit codes are unchanged).
   other), and an entry naming a metric absent from the catalog is
   dead weight.  New subsystems therefore can't hide behind the
   allowlist: the moment their emitter lands, only the catalog rules.
+* **KTPU504** — a span start site (``start_span`` / a device
+  ``stage(...)`` timer) whose name is absent from the span catalog
+  (``observability/catalog.py:SPANS``), or whose name cannot be
+  resolved at all.  Dynamic (f-string) names are checked by literal
+  prefix against the catalog, so route-templated spans like
+  ``webhooks/<route>`` stay checkable.
+* **KTPU505** — dead span: a cataloged span name nothing in the tree
+  starts — the span analogue of KTPU503, so the README span table
+  (generated from the same catalog) can never document spans that no
+  longer exist.
 """
 
 from __future__ import annotations
@@ -175,6 +185,150 @@ def _check_dead_metrics(ctx: Context) -> Iterable[Finding]:
             'KTPU503', line,
             f'DEAD_METRIC_ALLOWLIST: {name} {problem} — drop the '
             f'stale allowlist entry')
+
+
+# -- span catalog (KTPU504/505) ----------------------------------------------
+
+def load_span_catalog() -> Dict[str, str]:
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from kyverno_tpu.observability.catalog import SPANS
+    return dict(SPANS)
+
+
+def _fstring_prefix(node: ast.JoinedStr) -> str:
+    """Leading literal text of an f-string span name — the checkable
+    part of a templated name like ``f'webhooks{path}'``."""
+    prefix = ''
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            prefix += part.value
+        else:
+            break
+    return prefix
+
+
+def collect_span_sites(files: List[SourceFile]
+                       ) -> Tuple[List[Tuple[SourceFile, int, str]],
+                                  List[Tuple[SourceFile, int, str]],
+                                  List[Tuple[SourceFile, int, str]]]:
+    """Span start sites across a parsed file set: (exact
+    [(file, line, name)], dynamic [(file, line, prefix)], unresolved
+    [(file, line, description)]).
+
+    ``start_span(<name>)`` sites contribute the name directly; device
+    ``stage('<s>')`` timers contribute ``kyverno/device/<s>`` (the
+    generic ``f'kyverno/device/{name}'`` start inside ``stage`` itself
+    lands in the dynamic set)."""
+    all_consts: Dict[str, str] = {}
+    for sf in files:
+        if sf.tree is not None:
+            all_consts.update(_module_constants(sf.tree))
+    exact: List[Tuple[SourceFile, int, str]] = []
+    dynamic: List[Tuple[SourceFile, int, str]] = []
+    unresolved: List[Tuple[SourceFile, int, str]] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        local_consts = _module_constants(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else \
+                (func.id if isinstance(func, ast.Name) else '')
+            if attr not in ('start_span', 'stage'):
+                continue
+            arg = node.args[0]
+            name: Optional[str] = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.Name):
+                name = local_consts.get(arg.id, all_consts.get(arg.id))
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = _fstring_prefix(arg)
+                if attr == 'stage':
+                    prefix = 'kyverno/device/' + prefix
+                dynamic.append((sf, node.lineno, prefix))
+                continue
+            if name is None:
+                # a `stage` param (def stage(name...)) has no literal —
+                # only calls matter, and non-constant args through a
+                # variable are uncheckable
+                unresolved.append((sf, node.lineno, ast.dump(arg)[:80]))
+                continue
+            if attr == 'stage':
+                name = 'kyverno/device/' + name
+            exact.append((sf, node.lineno, name))
+    return exact, dynamic, unresolved
+
+
+@register('KTPU504', 'span start site with a name missing from the '
+                     'span catalog (observability/catalog.py SPANS) '
+                     'or unresolvable')
+def _check_uncataloged_spans(ctx: Context) -> Iterable[Finding]:
+    catalog = load_span_catalog()
+    exact, dynamic, unresolved = collect_span_sites(ctx.files)
+    for sf, line, name in exact:
+        if name not in catalog:
+            yield sf.finding(
+                'KTPU504', line,
+                f'span {name!r} is not in the span catalog '
+                f'(observability/catalog.py SPANS) — catalog it with '
+                f'help text')
+    for sf, line, prefix in dynamic:
+        if not prefix or not any(s.startswith(prefix) for s in catalog):
+            yield sf.finding(
+                'KTPU504', line,
+                f'dynamic span name with prefix {prefix!r} matches no '
+                f'span catalog entry — catalog a templated name '
+                f'(e.g. "{prefix}<...>")')
+    for sf, line, desc in unresolved:
+        yield sf.finding(
+            'KTPU504', line,
+            f'span name is not a literal, module constant, or '
+            f'f-string ({desc}) — uncheckable, use a constant')
+
+
+@register('KTPU505', 'dead span: cataloged span name with no start '
+                     'site in the tree')
+def _check_dead_spans(ctx: Context) -> Iterable[Finding]:
+    catalog = load_span_catalog()
+    exact, dynamic, _unresolved = collect_span_sites(ctx.files)
+    used = {name for _sf, _l, name in exact}
+    for _sf, _l, prefix in dynamic:
+        if prefix:
+            used |= {s for s in catalog if s.startswith(prefix)}
+    anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
+
+    def locate(name):
+        target = anchor if anchor is not None else ctx.files[0]
+        line = 1
+        if anchor is not None:
+            for i, text in enumerate(anchor.lines, start=1):
+                if f"'{name}'" in text:
+                    line = i
+                    break
+        return target, line
+
+    for name in sorted(catalog):
+        if name in used:
+            continue
+        target, line = locate(name)
+        yield target.finding(
+            'KTPU505', line,
+            f'span catalog: {name!r} has no start site in the tree — '
+            f'remove the entry or add the span')
+
+
+def render_span_table() -> str:
+    """The README span table, generated from the catalog so docs
+    cannot drift from it (mirrors the knob table)."""
+    rows = ['| Span | Covers |', '|---|---|']
+    catalog = load_span_catalog()
+    for name in sorted(catalog):
+        rows.append(f'| `{name}` | {catalog[name]} |')
+    return '\n'.join(rows)
 
 
 # -- standalone API for the scripts/check_metric_names.py shim ---------------

@@ -60,11 +60,16 @@ class Setup:
             from ..observability.metrics import set_global_registry
             from ..observability import coverage
             from ..observability import device as device_telemetry
+            from ..observability import provenance
             set_global_registry(self.metrics)
             device_telemetry.configure(self.metrics)
             # device-coverage ledger: per-rule placement + attributed
             # host-fallback counters (GET /debug/coverage with --profile)
             coverage.configure(self.metrics)
+            # decision provenance: per-decision serving-path records +
+            # the flight recorder (GET /debug/decisions with --profile;
+            # KTPU_FLIGHT_N=0 keeps it off)
+            provenance.configure(self.metrics)
         self.configuration = Configuration()
         if client is None:
             from ..dclient.client import FakeClient

@@ -200,6 +200,11 @@ class BatchScanner:
             if pl.placement == coverage.PLACEMENT_HOST}
         if coverage.enabled():
             coverage.record_placements(self._placements)
+        # the AOT-cache fingerprint of this scanner's policy set —
+        # decision-provenance records carry it so a flight-recorder
+        # line names exactly which compiled set served the decision
+        from ..aotcache.keys import policy_set_fingerprint
+        self.fingerprint = policy_set_fingerprint(policies)
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
         from collections import OrderedDict
@@ -397,8 +402,11 @@ class BatchScanner:
         device = self._small_device() if small else None
         # pipeline stages run on worker threads where the contextvar
         # span is absent — capture the request/scan span here so every
-        # stage span joins the caller's trace
+        # stage span joins the caller's trace (and the provenance
+        # capture, so multi-chunk scans attribute worker-thread stage
+        # time to the right scan)
         tel_parent = tracing.current_span()
+        tel_capture = devtel.current_capture()
 
         # multi-chunk scans encode in forked worker processes (off-GIL);
         # small scans stay in-process
@@ -412,6 +420,10 @@ class BatchScanner:
                 return batch.tensors()
 
         def encode(start):
+            with devtel.install_capture(tel_capture):
+                return encode_work(start)
+
+        def encode_work(start):
             part = resources[start:start + chunk]
             part_ctx = contexts[start:start + chunk] \
                 if contexts is not None else None
@@ -432,10 +444,12 @@ class BatchScanner:
             # one wrapper span per chunk: entering it on the dispatch
             # thread seeds the contextvar so the pack/h2d/compile/
             # device_eval/d2h child spans (ops/eval.py + below) nest
-            # under it — and under the request trace via tel_parent
-            with tracing.tracer().start_span(
-                    'kyverno/device/chunk', {'chunk_start': start},
-                    parent=tel_parent):
+            # under it — and under the request trace via tel_parent;
+            # the provenance capture rides the same re-install
+            with devtel.install_capture(tel_capture), \
+                    tracing.tracer().start_span(
+                        'kyverno/device/chunk', {'chunk_start': start},
+                        parent=tel_parent):
                 return dispatch_work(enc_future, start)
 
         def dispatch_work(enc_future, start):
@@ -665,6 +679,10 @@ class BatchScanner:
             # per-scan coverage-ratio gauge
             if tally is not None:
                 tally.finish()
+                from ..observability import device as devtel
+                cap = devtel.current_capture()
+                if cap is not None:
+                    cap.coverage_ratio = tally.ratio()
 
     def _assemble_chunk(self, resources, wrapped, match, start, status,
                         detail, fdet, now, ts, background_mode,
@@ -909,6 +927,10 @@ class BatchScanner:
         finally:
             if tally is not None:
                 tally.finish()
+                from ..observability import device as devtel
+                cap = devtel.current_capture()
+                if cap is not None:
+                    cap.coverage_ratio = tally.ratio()
 
     def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
               fly: Dict[Tuple, Any], resource: Optional[dict] = None,

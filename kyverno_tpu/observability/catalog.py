@@ -1,12 +1,15 @@
-"""Metric catalog: the single source of truth for every metric name
-this process exports.
+"""Metric + span catalog: the single source of truth for every metric
+name this process exports and every span name it starts.
 
 Each series emitted through :class:`MetricsRegistry` (``inc`` /
 ``observe`` / ``set_gauge`` / ``clear_gauge``) must use a name listed
 here with its type and help text; ``scripts/check_metric_names.py``
 (run by ``tests/test_metric_catalog.py``) statically verifies every
 call site against this table, so a typo'd or undocumented metric name
-fails tier-1 instead of silently forking a series.
+fails tier-1 instead of silently forking a series.  The ``SPANS``
+table plays the same role for trace span names (KTPU504/505 in
+ktpu-lint): a ``start_span`` site whose name is absent here — or a
+cataloged span nothing starts — is catalog drift.
 """
 
 from __future__ import annotations
@@ -101,4 +104,47 @@ METRICS: Dict[str, Metric] = {
         '(KTPU_AOT_CACHE_DIR).'),
     'kyverno_tpu_aot_cache_entries': Metric(
         'gauge', 'Persisted AOT executable entries on disk.'),
+    # decision provenance (observability/provenance.py)
+    'kyverno_tpu_decision_duration_seconds': Metric(
+        'histogram', 'End-to-end per-decision latency by serving '
+        'path=batch|sync|shed:<reason>|cache_replay|host_fallback.'),
+    'kyverno_tpu_decision_device_share_seconds': Metric(
+        'histogram', 'Amortized device time one decision consumed '
+        '(its batch device_eval time / riders; sync decisions carry '
+        'their whole scan).'),
+    # tracing health (observability/tracing.py)
+    'kyverno_tpu_trace_export_errors_total': Metric(
+        'counter', 'Span-exporter failures by exporter class; an '
+        'exporter failing repeatedly is dropped after this counts it, '
+        'so a dead exporter is visible instead of silent.'),
+}
+
+
+#: every span name this process starts, with what it covers — the
+#: tracing analogue of METRICS, drift-checked by ktpu-lint KTPU504/505.
+#: ``<...>`` segments mark route-/name-templated spans whose start
+#: sites build the name dynamically (an f-string site is checked by its
+#: literal prefix).
+SPANS: Dict[str, str] = {
+    'webhooks/<route>': 'Admission HTTP handler root span (one per '
+                        'request; route-templated).',
+    'kyverno/engine/rule': 'One host-engine rule execution.',
+    'kyverno/serving/batch': 'One coalesced admission dispatch (batch '
+                             'serving mode); carries occupancy.',
+    'kyverno/device/scan': 'One device-scan chunk: device wait + host '
+                           'assembly.',
+    'kyverno/device/chunk': 'Dispatch-thread wrapper seeding the '
+                            'per-chunk stage spans.',
+    'kyverno/device/pack': 'Pack-plan build stage.',
+    'kyverno/device/encode': 'Host feature-extraction (encode) stage.',
+    'kyverno/device/h2d': 'Host-to-device transfer stage.',
+    'kyverno/device/compile': 'Executable lookup / XLA compile stage.',
+    'kyverno/device/device_eval': 'Device evaluation dispatch stage.',
+    'kyverno/device/d2h': 'Device-to-host readback stage (stall-'
+                          'watchdog armed).',
+    'kyverno/device/report': 'Response/report assembly stage.',
+    'kyverno/rescan': 'One background reconcile tick (verdict-cache '
+                      'filter + dense scan of the misses).',
+    'kyverno/background/ur': 'One UpdateRequest sync.',
+    'kyverno/aot/warmer': 'Background AOT warm-up pass.',
 }

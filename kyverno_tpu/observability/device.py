@@ -22,11 +22,12 @@ timings and bit-identical PolicyReport output are unaffected.
 from __future__ import annotations
 
 import collections
+import contextvars
 import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from . import tracing
 from .metrics import (WIDE_BUCKETS, MetricsRegistry, global_registry)
@@ -46,6 +47,22 @@ _log = logging.getLogger('kyverno.device')
 _registry: Optional[MetricsRegistry] = None
 _watchdog: Optional['D2HWatchdog'] = None
 _event_sink: Optional[Callable[[dict], None]] = None
+#: additional watchdog-event listeners (the flight recorder registers
+#: its dump trigger here); independent of configure()'s event_sink so
+#: provenance and a caller-supplied sink compose
+_extra_sinks: List[Callable[[dict], None]] = []
+
+
+def add_event_sink(fn: Callable[[dict], None]) -> None:
+    if fn not in _extra_sinks:
+        _extra_sinks.append(fn)
+
+
+def remove_event_sink(fn: Callable[[dict], None]) -> None:
+    try:
+        _extra_sinks.remove(fn)
+    except ValueError:
+        pass
 
 
 def _stall_threshold_default() -> float:
@@ -100,6 +117,69 @@ def enabled() -> bool:
     return _registry is not None or tracing.tracer().enabled
 
 
+# -- per-scan capture -------------------------------------------------------
+
+#: the decision-provenance accumulator for the scan running on this
+#: thread/context (None almost always — one contextvar read per stage)
+_capture_var: contextvars.ContextVar[Optional['ScanCapture']] = \
+    contextvars.ContextVar('ktpu_scan_capture', default=None)
+
+
+class ScanCapture:
+    """Per-scan stage-time accumulator for decision provenance:
+    installed around one ``scanner.scan`` / ``scan_report_results``
+    call, it collects the scan's own stage durations (``device_eval``
+    drives the amortized per-rider device-time share), the AOT
+    executable-cache outcome, and the scan's device-coverage ratio —
+    without attributing concurrent scans' stages to each other the way
+    a registry-sum delta would."""
+
+    __slots__ = ('stages', 'aot', 'coverage_ratio', '_lock')
+
+    def __init__(self):
+        self.stages: Dict[str, float] = {}
+        self.aot = ''
+        self.coverage_ratio: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self.stages[stage] = self.stages.get(stage, 0.0) + seconds
+
+    def stage_s(self, stage: str) -> float:
+        with self._lock:
+            return self.stages.get(stage, 0.0)
+
+
+class _CaptureScope:
+    __slots__ = ('capture', '_token')
+
+    def __init__(self, capture: Optional[ScanCapture]):
+        self.capture = capture
+        self._token = None
+
+    def __enter__(self) -> Optional[ScanCapture]:
+        if self.capture is not None:
+            self._token = _capture_var.set(self.capture)
+        return self.capture
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _capture_var.reset(self._token)
+
+
+def install_capture(capture: Optional[ScanCapture]) -> _CaptureScope:
+    """Context manager making ``capture`` the ambient scan accumulator
+    (no-op for None).  The scan pipeline re-installs it on its worker
+    threads (``compiler/scan.py`` encode/dispatch closures), the same
+    way stage spans re-parent through ``tel_parent``."""
+    return _CaptureScope(capture)
+
+
+def current_capture() -> Optional[ScanCapture]:
+    return _capture_var.get()
+
+
 # -- stage timers -----------------------------------------------------------
 
 class _NoopStage:
@@ -122,12 +202,13 @@ _NOOP_STAGE = _NoopStage()
 
 
 class _Stage:
-    __slots__ = ('stage', 'span', '_t0')
+    __slots__ = ('stage', 'span', '_t0', '_capture')
 
-    def __init__(self, stage: str, span, t0: float):
+    def __init__(self, stage: str, span, t0: float, capture=None):
         self.stage = stage
         self.span = span
         self._t0 = t0
+        self._capture = capture
 
     def set_attribute(self, key, value):
         self.span.set_attribute(key, value)
@@ -141,10 +222,12 @@ class _Stage:
 
     def __exit__(self, exc_type, exc, tb):
         self.span.__exit__(exc_type, exc, tb)
+        elapsed = time.monotonic() - self._t0
         if _registry is not None:
-            _registry.observe(SCAN_STAGE_DURATION,
-                              time.monotonic() - self._t0,
+            _registry.observe(SCAN_STAGE_DURATION, elapsed,
                               stage=self.stage)
+        if self._capture is not None:
+            self._capture.add(self.stage, elapsed)
         return False
 
 
@@ -152,13 +235,16 @@ def stage(name: str, attributes: Optional[Dict[str, Any]] = None,
           parent=None):
     """Context manager timing one pipeline stage: a
     ``kyverno/device/<name>`` span (child of ``parent`` or the context
-    span) plus a stage-labelled histogram sample.  Returns a shared
-    no-op when telemetry is unconfigured."""
-    if _registry is None and not tracing.tracer().enabled:
+    span) plus a stage-labelled histogram sample (and a line in the
+    active provenance ScanCapture, when one is installed).  Returns a
+    shared no-op when telemetry is unconfigured."""
+    capture = _capture_var.get()
+    if _registry is None and capture is None and \
+            not tracing.tracer().enabled:
         return _NOOP_STAGE
     span = tracing.tracer().start_span(f'kyverno/device/{name}',
                                        attributes, parent=parent)
-    return _Stage(name, span, time.monotonic())
+    return _Stage(name, span, time.monotonic(), capture)
 
 
 # -- counters / gauges ------------------------------------------------------
@@ -167,6 +253,11 @@ def record_cache(result: str) -> None:
     """Executable-cache outcome: hit | miss | aot_load | aot_store."""
     if _registry is not None:
         _registry.inc(COMPILE_CACHE_REQUESTS, result=result)
+    capture = _capture_var.get()
+    if capture is not None and result != 'aot_store':
+        # the scan's lookup outcome (aot_store is the async write-back
+        # that follows a miss, not a distinct lookup result)
+        capture.aot = result
 
 
 def set_batch_size(n: int) -> None:
@@ -265,8 +356,9 @@ class D2HWatchdog:
         from .logging import with_values
         with_values(_log, 'd2h readback stalled', level=logging.ERROR,
                     **{k: v for k, v in event.items() if k != 'type'})
-        sink = _event_sink
-        if sink is not None:
+        sinks = ([_event_sink] if _event_sink is not None else []) \
+            + list(_extra_sinks)
+        for sink in sinks:
             try:
                 sink(event)
             except Exception:  # noqa: BLE001 - sinks must not break d2h
@@ -301,7 +393,8 @@ class _D2HGuard:
 
 def d2h_guard(attributes: Optional[Dict[str, Any]] = None, parent=None):
     """``stage('d2h')`` with the stall watchdog armed for its duration."""
-    if _registry is None and not tracing.tracer().enabled:
+    if _registry is None and _capture_var.get() is None and \
+            not tracing.tracer().enabled:
         return _NOOP_STAGE
     token = _watchdog.arm(attributes) if _watchdog is not None else -1
     return _D2HGuard(stage('d2h', attributes, parent=parent), token)

@@ -10,7 +10,10 @@ Python equivalent of Go's net/http/pprof surface:
   every thread sampled at ~100 Hz for N seconds, returned as folded
   stacks (``frame;frame;frame count`` lines — flamegraph-ready)
 * ``/debug/traces`` — recent spans from the in-memory trace exporter as
-  OTLP-shaped JSON
+  OTLP-shaped JSON (``?limit=N`` bounds the response, ``?trace_id=...``
+  narrows to one trace)
+* ``/debug/decisions`` — the decision-provenance flight recorder: last
+  N DecisionRecords + the error/shed ring (``?limit=N``)
 * ``/debug/coverage`` — the device-coverage ledger (per-rule placement,
   attributed host-fallback counts) as JSON
 * ``/metrics`` — Prometheus text exposition of the active registry
@@ -91,7 +94,7 @@ class ProfilingServer:
                 parsed = urlparse(self.path)
                 if parsed.path in ('/debug/pprof', '/debug/pprof/'):
                     self._send('profiles:\n  goroutine\n  profile\n'
-                               '  traces\n  coverage\n')
+                               '  traces\n  decisions\n  coverage\n')
                 elif parsed.path == '/debug/pprof/goroutine':
                     self._send(thread_stacks())
                 elif parsed.path == '/debug/pprof/profile':
@@ -106,10 +109,48 @@ class ProfilingServer:
                 elif parsed.path == '/debug/traces':
                     from . import tracing
                     mem = tracing.memory_exporter()
-                    spans = [s.to_otlp() for s in mem.spans()] \
-                        if mem is not None else []
-                    self._send(json.dumps({'spans': spans}),
-                               'application/json')
+                    spans = mem.spans() if mem is not None else []
+                    q = parse_qs(parsed.query)
+                    # ?trace_id= narrows to one trace, ?limit=N bounds
+                    # the response to the most recent N — flight-
+                    # recorder follow-ups fetch one decision's spans
+                    # instead of paging the whole ring
+                    trace_id = q.get('trace_id', [''])[0]
+                    if trace_id:
+                        spans = [s for s in spans
+                                 if s.trace_id == trace_id]
+                    try:
+                        limit = int(q.get('limit', ['0'])[0])
+                    except ValueError:
+                        self._send('bad limit parameter', code=400)
+                        return
+                    if limit > 0:
+                        spans = spans[-limit:]
+                    self._send(json.dumps(
+                        {'spans': [s.to_otlp() for s in spans]}),
+                        'application/json')
+                elif parsed.path == '/debug/decisions':
+                    from . import provenance
+                    rec = provenance.recorder()
+                    if rec is None:
+                        self._send(json.dumps({'enabled': False}),
+                                   'application/json')
+                        return
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get('limit', ['0'])[0]) or None
+                    except ValueError:
+                        self._send('bad limit parameter', code=400)
+                        return
+                    body = {
+                        'enabled': True,
+                        'stats': rec.stats(),
+                        'decisions': [r.to_dict()
+                                      for r in rec.records(limit)],
+                        'errors': [r.to_dict()
+                                   for r in rec.errors(limit)],
+                    }
+                    self._send(json.dumps(body), 'application/json')
                 elif parsed.path == '/debug/coverage':
                     from . import coverage
                     led = coverage.ledger()

@@ -16,10 +16,20 @@ tracer allocates nothing per span).
 from __future__ import annotations
 
 import contextvars
+import os
 import secrets
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+#: exporter failures are counted per exporter class before the
+#: exporter is dropped, so a dead exporter is visible on /metrics
+#: instead of silently discarding spans
+TRACE_EXPORT_ERRORS = 'kyverno_tpu_trace_export_errors_total'
+
+#: consecutive export failures before an exporter is dropped from the
+#: tracer (each one already counted on the error series)
+EXPORT_FAILURE_LIMIT = 8
 
 _current_span: contextvars.ContextVar[Optional['Span']] = \
     contextvars.ContextVar('ktpu_current_span', default=None)
@@ -145,23 +155,61 @@ class JsonlExporter:
 
     Serves the bench path: a scan run leaves a machine-readable
     per-stage record on disk (``stage_breakdown`` assembly) without a
-    collector.  Writes are line-buffered and locked; a write failure
-    disables the exporter rather than breaking the span path."""
+    collector.  Writes are line-buffered and locked.  The file rotates
+    by size (``KTPU_TRACE_JSONL_MAX_BYTES``; 0 disables): when the next
+    line would exceed the budget, the current file moves to
+    ``<path>.1`` (one rotated generation kept) and a fresh file opens —
+    long benches no longer grow the trace file without bound.  A write
+    failure closes the exporter and re-raises so ``Tracer._export``
+    counts it on ``kyverno_tpu_trace_export_errors_total``."""
 
-    def __init__(self, path: str):
+    DEFAULT_MAX_BYTES = 64 << 20
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(
+                    'KTPU_TRACE_JSONL_MAX_BYTES',
+                    str(self.DEFAULT_MAX_BYTES)))
+            except ValueError:
+                max_bytes = self.DEFAULT_MAX_BYTES
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._file = open(path, 'a', buffering=1)
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
 
     def __call__(self, span: Span) -> None:
         with self._lock:
             if self._file is None:
                 return
+            import json
+            line = json.dumps(span.to_otlp()) + '\n'
             try:
-                import json
-                self._file.write(json.dumps(span.to_otlp()) + '\n')
+                if self.max_bytes > 0 and \
+                        self._bytes + len(line) > self.max_bytes:
+                    self._rotate()
+                self._file.write(line)
+                self._bytes += len(line)
             except (OSError, ValueError):
                 self.close()
+                raise
+
+    def _rotate(self) -> None:
+        """Current file → ``<path>.1`` (replacing any prior rotation),
+        then reopen fresh.  Called under the lock."""
+        f, self._file = self._file, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        os.replace(self.path, self.path + '.1')
+        self._file = open(self.path, 'a', buffering=1)
+        self._bytes = 0
 
     def close(self) -> None:
         f, self._file = self._file, None
@@ -179,6 +227,9 @@ class Tracer:
                  = None, enabled: bool = True):
         self.exporters = exporters or []
         self.enabled = enabled
+        # consecutive failures per exporter (id-keyed; reset on any
+        # successful export) — drives the drop-after-N policy
+        self._export_failures: Dict[int, int] = {}
 
     def start_span(self, name: str,
                    attributes: Optional[Dict[str, Any]] = None,
@@ -193,11 +244,32 @@ class Tracer:
                     else _current_span.get(), attributes)
 
     def _export(self, span: Span) -> None:
-        for exporter in self.exporters:
+        for exporter in list(self.exporters):
             try:
                 exporter(span)
             except Exception:  # noqa: BLE001 - exporters must not break
+                self._count_export_error(exporter)
+            else:
+                if self._export_failures:
+                    self._export_failures.pop(id(exporter), None)
+
+    def _count_export_error(self, exporter) -> None:
+        """A span exporter raised: count it (so a dead exporter shows
+        on /metrics) and drop the exporter after EXPORT_FAILURE_LIMIT
+        consecutive failures instead of burning a raise per span."""
+        from .metrics import global_registry
+        registry = global_registry()
+        if registry is not None:
+            registry.inc(TRACE_EXPORT_ERRORS,
+                         exporter=type(exporter).__name__)
+        n = self._export_failures.get(id(exporter), 0) + 1
+        self._export_failures[id(exporter)] = n
+        if n >= EXPORT_FAILURE_LIMIT:
+            try:
+                self.exporters.remove(exporter)
+            except ValueError:
                 pass
+            self._export_failures.pop(id(exporter), None)
 
 
 _NOOP_TRACER = Tracer(enabled=False)
@@ -242,6 +314,8 @@ def memory_exporter() -> Optional[InMemoryExporter]:
 
 
 def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    # ktpu: noqa[KTPU504] -- forwarder: span names are checked against
+    # the catalog at each caller's site, not at this pass-through
     return _tracer.start_span(name, attributes)
 
 

@@ -254,8 +254,13 @@ class BackgroundScanController:
         if not work:
             return []
         now = time.time() if now is None else now
-        from ..observability import tracing
+        from ..observability import provenance, tracing
         from ..verdictcache import publish_tick
+        # decision provenance: every rescan row yields one record —
+        # cache_replay (digest, zero device share), batch (dense-scan
+        # riders share the tick's device_eval time), or host_fallback
+        # (exception-present host sweep)
+        prov_on = provenance.enabled()
         # PolicyExceptions are rare and rule-targeted; when any exist
         # the host engine decides (exception semantics:
         # pkg/engine/validation.go:826 hasPolicyExceptions — the
@@ -272,11 +277,16 @@ class BackgroundScanController:
                 stream = self._host_scan(work, exceptions)
                 for uid, resource, rhash, responses in zip(
                         uids, work, hashes, stream):
+                    t_row = time.monotonic() if prov_on else 0.0
                     report = self._store_report(uid, resource, responses,
                                                 now, rhash)
                     self._scanned[uid] = (rhash, now)
                     if report is not None:
                         reports.append(report)
+                    if prov_on:
+                        self._record_row(
+                            provenance, 'host_fallback', uid, resource,
+                            duration_s=time.monotonic() - t_row)
                 self._tick_stats(span, publish_tick, len(work),
                                  scanned=len(work), replayed=0)
                 return reports
@@ -298,6 +308,7 @@ class BackgroundScanController:
                         miss_digests.append(digest)
                         miss_hashes.append(rhash)
                         continue
+                    t_row = time.monotonic() if prov_on else 0.0
                     report = self._store_fused_report(
                         uid, resource, vc.replay(row, self.policies, ts),
                         now, rhash)
@@ -305,6 +316,11 @@ class BackgroundScanController:
                     if report is not None:
                         reports.append(report)
                     replayed += 1
+                    if prov_on:
+                        self._record_row(
+                            provenance, 'cache_replay', uid, resource,
+                            duration_s=time.monotonic() - t_row,
+                            verdict_digest=digest)
             else:
                 miss_uids, miss_work, miss_hashes = uids, work, hashes
                 miss_digests = [''] * len(work)
@@ -312,24 +328,59 @@ class BackgroundScanController:
             # straight from the device cells (bit-identity pinned by
             # tests/test_report_fusion), rows written back to the cache
             if miss_work:
-                for uid, resource, digest, rhash, row in zip(
-                        miss_uids, miss_work, miss_digests, miss_hashes,
-                        self.scanner.scan_report_results(miss_work, now)):
-                    report = self._store_fused_report(uid, resource, row,
-                                                      now, rhash)
-                    self._scanned[uid] = (rhash, now)
-                    if report is not None:
-                        reports.append(report)
-                    if vc is not None:
-                        results, summary, row_policies = row
-                        vc.store(digest, uid, results, summary,
-                                 [self._policy_index[id(p)]
-                                  for p in row_policies])
+                from ..observability import device as devtel
+                cap = devtel.ScanCapture() if prov_on else None
+                t_scan = time.monotonic() if prov_on else 0.0
+                with devtel.install_capture(cap):
+                    for uid, resource, digest, rhash, row in zip(
+                            miss_uids, miss_work, miss_digests,
+                            miss_hashes,
+                            self.scanner.scan_report_results(miss_work,
+                                                             now)):
+                        report = self._store_fused_report(
+                            uid, resource, row, now, rhash)
+                        self._scanned[uid] = (rhash, now)
+                        if report is not None:
+                            reports.append(report)
+                        if vc is not None:
+                            results, summary, row_policies = row
+                            vc.store(digest, uid, results, summary,
+                                     [self._policy_index[id(p)]
+                                      for p in row_policies])
+                if prov_on:
+                    # dense-scanned rows are riders of one shared tick
+                    # scan: the tick's device_eval time amortizes over
+                    # them exactly like an admission batch's riders
+                    n_miss = len(miss_work)
+                    elapsed = time.monotonic() - t_scan
+                    device_eval_s = cap.stage_s('device_eval')
+                    batch_id = provenance.next_batch_id('rescan')
+                    for uid, resource in zip(miss_uids, miss_work):
+                        self._record_row(
+                            provenance, 'batch', uid, resource,
+                            duration_s=elapsed / n_miss,
+                            batch_id=batch_id, occupancy=n_miss,
+                            device_share_s=device_eval_s / n_miss,
+                            device_eval_s=device_eval_s,
+                            aot_cache=cap.aot,
+                            coverage_ratio=cap.coverage_ratio)
             self._tick_stats(span, publish_tick, len(work),
                              scanned=len(miss_work), replayed=replayed)
         if vc is not None:
             vc.flush()
         return reports
+
+    def _record_row(self, provenance, path: str, uid: str,
+                    resource: dict, **fields) -> None:
+        """One rescan row's DecisionRecord (resource identity + the
+        controller's policy-set fingerprint folded in)."""
+        meta = resource.get('metadata') or {}
+        provenance.record_decision(
+            path=path, source='rescan', uid=uid,
+            kind=resource.get('kind', '') or '',
+            namespace=meta.get('namespace', '') or '',
+            name=meta.get('name', '') or '',
+            fingerprint=self._policy_fingerprint, **fields)
 
     def _tick_stats(self, span, publish_tick, pending: int, scanned: int,
                     replayed: int) -> None:

@@ -149,12 +149,19 @@ class AdmissionBatcher:
             return pctx_of.get(id(doc), lead_pctx)
 
         self._observe(batch, t0)
+        from ..observability import device as devtel
+        from ..observability import provenance
+        # per-dispatch provenance capture: device_eval time of THIS
+        # scan (not a registry-sum delta a concurrent rescan could
+        # contaminate) amortizes over the riders as their device share
+        cap = devtel.ScanCapture() if provenance.enabled() else None
         try:
-            with tracing.tracer().start_span(
-                    'kyverno/serving/batch',
-                    {'occupancy': len(batch),
-                     'window_ms': self.window_s * 1000.0},
-                    parent=lead.span):
+            with devtel.install_capture(cap), \
+                    tracing.tracer().start_span(
+                        'kyverno/serving/batch',
+                        {'occupancy': len(batch),
+                         'window_ms': self.window_s * 1000.0},
+                        parent=lead.span):
                 rows = scanner.scan(resources, contexts=contexts,
                                     admission=lead.admission,
                                     pctx_factory=pctx_factory)
@@ -164,7 +171,28 @@ class AdmissionBatcher:
                 self.sheds.record(shed_policy.REASON_SCAN_ERROR)
             if self.on_failure is not None:
                 self.on_failure(lead.policies, e)
+            # flight-recorder dump last: the riders and the breaker are
+            # already notified, so the (file-writing) dump never delays
+            # recovery — the ring's history lands on disk next to the
+            # failure that shed this batch
+            provenance.notify_scan_error(e)
             return
+        if cap is not None:
+            device_eval_s = cap.stage_s('device_eval')
+            share = device_eval_s / len(batch)
+            batch_id = provenance.next_batch_id()
+            for t in batch:
+                # filled before resolve(): the waiting webhook thread
+                # reads prov right after its future resolves
+                t.prov = {
+                    'batch_id': batch_id,
+                    'occupancy': len(batch),
+                    'queue_wait_s': t0 - t.enqueued_at,
+                    'device_share_s': share,
+                    'device_eval_s': device_eval_s,
+                    'aot_cache': cap.aot,
+                    'coverage_ratio': cap.coverage_ratio,
+                }
         for t, row in zip(batch, rows):
             t.resolve(row)
         if self.on_success is not None:

@@ -51,7 +51,8 @@ class Ticket:
 
     __slots__ = ('key', 'resource', 'context', 'pctx', 'admission',
                  'scanner', 'policies', 'span', 'on_shed', 'enqueued_at',
-                 'state', 'responses', 'shed_reason', '_lock', '_event')
+                 'state', 'responses', 'shed_reason', 'prov', '_lock',
+                 '_event')
 
     def __init__(self, key, resource: dict, context: Optional[dict],
                  pctx, admission: tuple, scanner, policies,
@@ -69,6 +70,11 @@ class Ticket:
         self.state = PENDING
         self.responses: Optional[list] = None
         self.shed_reason: Optional[str] = None
+        #: decision-provenance fields the batcher fills at dispatch
+        #: (batch id, occupancy, queue wait, amortized device share);
+        #: the waiting webhook thread folds them into its
+        #: DecisionRecord after resolve
+        self.prov: Optional[dict] = None
         self._lock = threading.Lock()
         self._event = threading.Event()
 

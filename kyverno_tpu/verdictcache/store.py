@@ -116,6 +116,10 @@ class VerdictCache:
         self._rows: 'OrderedDict[str, dict]' = OrderedDict()
         self._by_uid: Dict[str, Set[str]] = {}
         self._dirty = False
+        # local lookup outcome counters: benchmarks and the decision-
+        # provenance cross-checks read them without a metrics registry
+        self._hits = 0
+        self._misses = 0
         if root is not None:
             try:
                 os.makedirs(root, exist_ok=True)
@@ -157,6 +161,9 @@ class VerdictCache:
             row = self._rows.get(digest)
             if row is not None:
                 self._rows.move_to_end(digest)
+                self._hits += 1
+            else:
+                self._misses += 1
         reg = _reg()
         if reg is not None:
             if row is None:
@@ -355,4 +362,5 @@ class VerdictCache:
             except OSError:
                 size = 0
         with self._lock:
-            return {'entries': len(self._rows), 'snapshot_bytes': size}
+            return {'entries': len(self._rows), 'snapshot_bytes': size,
+                    'hits': self._hits, 'misses': self._misses}

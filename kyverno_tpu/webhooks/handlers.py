@@ -470,10 +470,15 @@ class ResourceHandlers:
     def _batched_scan(self, scanner, policies, request, pctx):
         """Route one CREATE validate scan through the micro-batcher.
 
-        Returns this request's per-policy responses, or None when the
-        request shed to the host engine loop (queue full, deadline
-        blown, dispatch failed, or batcher stopped) — the caller then
-        serves the identical-verdict host path, never a 500."""
+        Returns ``(responses, prov)``: this request's per-policy
+        responses (None when the request shed to the host engine loop —
+        queue full, deadline blown, dispatch failed, or batcher stopped
+        — the caller then serves the identical-verdict host path, never
+        a 500) and the decision-provenance fields of whatever happened:
+        ``path`` is ``batch`` with the batcher-filled batch id /
+        occupancy / amortized device share on success, or
+        ``shed:<reason>`` with the time spent waiting otherwise."""
+        import time as _time
         from ..serving import shed as shed_policy
         from ..serving.queue import QueueFull, Stopped
         batcher = self._get_batcher()
@@ -487,11 +492,20 @@ class ResourceHandlers:
                 policies=policies)
         except QueueFull:
             batcher.record_shed(shed_policy.REASON_QUEUE_FULL)
-            return None
+            return None, {'path':
+                          f'shed:{shed_policy.REASON_QUEUE_FULL}'}
         except Stopped:
             batcher.record_shed(shed_policy.REASON_SHUTDOWN)
-            return None
-        return ticket.wait(batcher.shed_deadline_s)
+            return None, {'path': f'shed:{shed_policy.REASON_SHUTDOWN}'}
+        responses = ticket.wait(batcher.shed_deadline_s)
+        if responses is None:
+            reason = ticket.shed_reason or shed_policy.REASON_DEADLINE
+            return None, {
+                'path': f'shed:{reason}',
+                'queue_wait_s': _time.monotonic() - ticket.enqueued_at}
+        prov = dict(ticket.prov) if ticket.prov is not None else {}
+        prov['path'] = 'batch'
+        return responses, prov
 
     def shutdown(self) -> None:
         """Drain and stop the admission batcher: pending futures get
@@ -511,9 +525,24 @@ class ResourceHandlers:
         ns = request.get('namespace', '')
         policies = self.cache.get_policies(pcache.VALIDATE_ENFORCE, kind, ns)
         generate_policies = self.cache.get_policies(pcache.GENERATE, kind, ns)
+        from ..observability import provenance
+        prov_on = provenance.enabled()
+        t_start = time.monotonic() if prov_on else 0.0
+        # decision provenance: which serving path answered this request
+        # (batch | sync | shed:<reason> | host_fallback) plus the
+        # batch/cache attribution that path produced
+        prov_path = 'host_fallback'
+        prov_extra: Dict[str, Any] = {}
         try:
             pctx = self.pc_builder.build(request)
         except Exception as e:  # noqa: BLE001
+            if prov_on:
+                provenance.record_decision(
+                    path='host_fallback', uid=uid, kind=kind,
+                    namespace=ns, name=request.get('name', '') or '',
+                    operation=request.get('operation', '') or '',
+                    duration_s=time.monotonic() - t_start,
+                    error=f'policy context build failed: {e}')
             return admission.response(uid, False,
                                       f'failed to build policy context: {e}')
         pctx.namespace_labels = self.namespace_labels(ns)
@@ -536,21 +565,40 @@ class ResourceHandlers:
                     # with concurrent same-policy-set requests into one
                     # shared device dispatch (serving/batcher.py); a
                     # shed comes back as None and the host loop serves
-                    batched = self._batched_scan(scanner, policies,
-                                                 request, pctx)
+                    batched, bprov = self._batched_scan(
+                        scanner, policies, request, pctx)
+                    prov_path = bprov.pop('path')
+                    prov_extra = bprov
+                    prov_extra['fingerprint'] = getattr(
+                        scanner, 'fingerprint', '')
                     if batched is None:
                         use_device = False
                     else:
                         responses = batched
                 else:
+                    from ..observability import device as devtel
                     resource = admission.request_resource(request)
-                    [responses] = scanner.scan(
-                        [resource],
-                        contexts=[pctx.json_context._data],
-                        admission=(pctx.admission_info,
-                                   pctx.exclude_group_roles,
-                                   pctx.namespace_labels, 'CREATE'),
-                        pctx_factory=lambda doc: pctx)
+                    cap = devtel.ScanCapture() if prov_on else None
+                    with devtel.install_capture(cap):
+                        [responses] = scanner.scan(
+                            [resource],
+                            contexts=[pctx.json_context._data],
+                            admission=(pctx.admission_info,
+                                       pctx.exclude_group_roles,
+                                       pctx.namespace_labels, 'CREATE'),
+                            pctx_factory=lambda doc: pctx)
+                    prov_path = 'sync'
+                    if cap is not None:
+                        device_eval_s = cap.stage_s('device_eval')
+                        prov_extra = {
+                            'occupancy': 1,
+                            'device_share_s': device_eval_s,
+                            'device_eval_s': device_eval_s,
+                            'aot_cache': cap.aot,
+                            'coverage_ratio': cap.coverage_ratio,
+                            'fingerprint': getattr(scanner,
+                                                   'fingerprint', ''),
+                        }
                     with self._scanner_lock:
                         # the limit counts consecutive failures per set
                         self._key_failures.pop(
@@ -568,8 +616,11 @@ class ResourceHandlers:
                 self._record_key_failure(
                     key, policies,
                     f'scan failed, falling back to host engine: {e}')
+                provenance.notify_scan_error(e)
                 use_device = False
                 responses = []
+                prov_path = 'host_fallback'
+                prov_extra = {'error': f'scan failed: {e}'}
         if not use_device:
             for policy in policies:
                 ctx = pctx.copy()
@@ -581,6 +632,12 @@ class ResourceHandlers:
         span = tracing.current_span()
         if span is not None:
             span.set_attribute('device_path', bool(use_device))
+        if prov_on:
+            provenance.record_decision(
+                path=prov_path, uid=uid, kind=kind, namespace=ns,
+                name=request.get('name', '') or '',
+                operation=request.get('operation', '') or '',
+                duration_s=time.monotonic() - t_start, **prov_extra)
         blocked = block_request(responses, failure_policy)
         if self.event_sink is not None and responses:
             # reference: handlers.go Validate -> webhooks/utils/event.go

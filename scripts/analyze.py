@@ -56,11 +56,17 @@ def main(argv=None) -> int:
                     help='comma-separated rule ids to run')
     ap.add_argument('--knob-table', action='store_true',
                     help='print the generated KTPU_* README table')
+    ap.add_argument('--span-table', action='store_true',
+                    help='print the generated README span table')
     ap.add_argument('--list-rules', action='store_true')
     args = ap.parse_args(argv)
 
     if args.knob_table:
         print(render_knob_table())
+        return 0
+    if args.span_table:
+        from kyverno_tpu.analysis.catalog_pass import render_span_table
+        print(render_span_table())
         return 0
     if args.list_rules:
         for rid in sorted(RULES):

@@ -321,6 +321,67 @@ def test_ktpu503_stale_allowlist_entry(tmp_path):
                for f in rep.active)
 
 
+# -- KTPU504/505: span catalog -----------------------------------------------
+
+def test_ktpu504_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    def f(tracing):
+        with tracing.start_span('kyverno/not/cataloged'):
+            pass
+    """}, rules=['KTPU504'])
+    assert rule_ids(rep) == {'KTPU504'}
+    rep = run(tmp_path, {'a.py': """\
+    def f(tracing):
+        with tracing.start_span('kyverno/rescan'):
+            pass
+    """}, rules=['KTPU504'])
+    assert not rep.active
+
+
+def test_ktpu504_dynamic_and_stage_sites(tmp_path):
+    # a route-templated f-string name is checked by literal prefix
+    rep = run(tmp_path, {'a.py': """\
+    def f(tracing, path):
+        with tracing.start_span(f'webhooks{path}'):
+            pass
+    """}, rules=['KTPU504'])
+    assert not rep.active
+    # device stage timers map to kyverno/device/<stage>
+    rep = run(tmp_path, {'a.py': """\
+    def f(devtel):
+        with devtel.stage('encode'):
+            pass
+    """}, rules=['KTPU504'])
+    assert not rep.active
+    rep = run(tmp_path, {'a.py': """\
+    def f(devtel):
+        with devtel.stage('not_a_stage'):
+            pass
+    """}, rules=['KTPU504'])
+    assert rule_ids(rep) == {'KTPU504'}
+    # a name flowing through a variable is uncheckable
+    rep = run(tmp_path, {'a.py': """\
+    def f(tracing, name):
+        with tracing.start_span(name):
+            pass
+    """}, rules=['KTPU504'])
+    assert rule_ids(rep) == {'KTPU504'}
+
+
+def test_ktpu505_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU505'])
+    assert rule_ids(rep) == {'KTPU505'}
+    # one dynamic site per prefix family marks the whole catalog used
+    rep = run(tmp_path, {'a.py': """\
+    def f(tracing, x):
+        with tracing.start_span(f'kyverno/{x}'):
+            pass
+        with tracing.start_span(f'webhooks{x}'):
+            pass
+    """}, rules=['KTPU505'])
+    assert not rep.active
+
+
 # -- KTPU00x: suppression hygiene (meta rules) -------------------------------
 
 def test_ktpu001_positive_negative(tmp_path):
@@ -462,7 +523,7 @@ def test_rule_registry_complete():
     expected = {'KTPU001', 'KTPU002', 'KTPU101', 'KTPU102', 'KTPU103',
                 'KTPU201', 'KTPU202', 'KTPU203', 'KTPU301', 'KTPU302',
                 'KTPU303', 'KTPU401', 'KTPU402', 'KTPU501', 'KTPU502',
-                'KTPU503'}
+                'KTPU503', 'KTPU504', 'KTPU505'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid

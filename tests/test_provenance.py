@@ -1,0 +1,457 @@
+"""Decision provenance & flight recorder
+(kyverno_tpu/observability/provenance.py).
+
+Pins the per-decision attribution contract: every admission decision
+and rescan row yields exactly one DecisionRecord naming its serving
+path; batch rider device-time shares sum to the batch's device_eval
+stage time; shed reasons match the shed ledger; cache replays carry
+the verdict digest and zero device share; the flight-recorder rings
+are bounded; watchdog/scan-error events dump the rings to JSONL; and
+output is bit-identical with provenance on vs off.  CPU-only, tier-1,
+timing-free (clocks injected where time matters).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.config.config import Configuration
+from kyverno_tpu.policycache import cache as pcache
+from kyverno_tpu.policycache.cache import Cache
+from kyverno_tpu.observability import device as devtel
+from kyverno_tpu.observability import provenance, tracing
+from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                               set_global_registry)
+from kyverno_tpu.serving import shed as shed_policy
+from kyverno_tpu.serving.batcher import AdmissionBatcher
+from kyverno_tpu.webhooks.handlers import ResourceHandlers
+from kyverno_tpu.webhooks.server import WebhookServer
+
+ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  background: true
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+
+def pod(labels, name, uid=None):
+    meta = {'name': name, 'namespace': 'default', 'labels': labels}
+    if uid is not None:
+        meta['uid'] = uid
+    return {'apiVersion': 'v1', 'kind': 'Pod', 'metadata': meta,
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def review_bytes(resource, uid):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': uid, 'operation': 'CREATE',
+            'kind': {'group': '', 'version': 'v1', 'kind': 'Pod'},
+            'namespace': 'default',
+            'name': resource['metadata']['name'],
+            'object': resource,
+            'userInfo': {'username': 'alice', 'groups': []},
+        }}).encode()
+
+
+@pytest.fixture(scope='module')
+def chain():
+    """One compiled serving chain for the whole module."""
+    cache = Cache()
+    cache.warm_up([Policy(d) for d in yaml.safe_load_all(ENFORCE_POLICY)])
+    handlers = ResourceHandlers(cache, configuration=Configuration(),
+                                serving_mode='batch')
+    server = WebhookServer(handlers, configuration=Configuration())
+    enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod',
+                                 'default')
+    assert handlers.wait_device_ready(enforce, timeout=600)
+    yield server, handlers
+    handlers.shutdown()
+
+
+@pytest.fixture
+def prov():
+    """Provenance + device telemetry on a fresh registry; everything
+    restored afterwards."""
+    registry = MetricsRegistry()
+    set_global_registry(registry)
+    devtel.configure(registry)
+    recorder = provenance.configure(registry, flight_n=4096,
+                                    dump_dir=None)
+    yield recorder, registry
+    provenance.disable()
+    devtel.disable()
+    set_global_registry(None)
+
+
+def drive(server, requests, n_threads=8):
+    barrier = threading.Barrier(n_threads)
+    chunks = [requests[i::n_threads] for i in range(n_threads)]
+    results = {}
+
+    def work(tid):
+        barrier.wait()
+        for uid, p in chunks[tid]:
+            results[uid] = server.handle('/validate/fail',
+                                         review_bytes(p, uid))
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    return results
+
+
+def mixed_requests(n, prefix='u'):
+    return [(f'{prefix}{i}',
+             pod({'team': 'infra'} if i % 2 else {}, f'p-{prefix}{i}'))
+            for i in range(n)]
+
+
+class TestAdmissionRecords:
+    def test_one_record_per_decision_and_shares_sum(self, chain, prov):
+        """32 concurrent batched decisions: exactly one record each;
+        riders of one batch agree on occupancy and their device-time
+        shares sum to that batch's device_eval time."""
+        server, handlers = chain
+        recorder, registry = prov
+        handlers._get_batcher().reset_stats()
+        requests = mixed_requests(32)
+        drive(server, requests)
+        records = recorder.records()
+        assert len(records) == len(requests)
+        # the flight recorder and the cataloged metrics agree: every
+        # record observed exactly once on the per-path histogram
+        series = registry.histogram_series(
+            'kyverno_tpu_decision_duration_seconds')
+        assert sum(count for _key, count, _total in series) == \
+            len(records)
+        assert {r.uid for r in records} == {uid for uid, _ in requests}
+        by_batch = {}
+        for r in records:
+            if r.path == 'batch':
+                by_batch.setdefault(r.batch_id, []).append(r)
+            else:
+                assert r.path.startswith('shed:'), r.path
+        assert by_batch, 'no batched decisions at all'
+        for batch_id, riders in by_batch.items():
+            assert batch_id
+            [occupancy] = {r.occupancy for r in riders}
+            assert occupancy == len(riders)
+            [device_eval_s] = {r.device_eval_s for r in riders}
+            assert sum(r.device_share_s for r in riders) == \
+                pytest.approx(device_eval_s, rel=1e-9)
+            [fp] = {r.fingerprint for r in riders}
+            assert fp  # the compiled set that served the batch
+
+    def test_sync_record_and_span_attribution(self, chain, prov):
+        """A sync decision records path=sync with its whole scan as
+        device share, joined to the handler span (ids both ways)."""
+        server, handlers = chain
+        recorder, registry = prov
+        mem = tracing.configure()
+        try:
+            prior = handlers.serving_mode
+            handlers.serving_mode = 'sync'
+            try:
+                server.handle('/validate/fail',
+                              review_bytes(pod({}, 'p-sync'), 'u-sync'))
+            finally:
+                handlers.serving_mode = prior
+        finally:
+            pass
+        [rec] = [r for r in recorder.records() if r.uid == 'u-sync']
+        assert rec.path == 'sync'
+        assert rec.occupancy == 1
+        assert rec.device_share_s == rec.device_eval_s
+        assert rec.aot_cache in ('hit', 'miss', 'aot_load')
+        assert rec.engine_rev
+        [root] = mem.find('webhooks/validate/fail')
+        assert rec.trace_id == root.trace_id
+        assert root.attributes['decision_path'] == 'sync'
+        tracing.disable()
+        # the cataloged per-path metrics observed this decision
+        assert registry.histogram_count(
+            'kyverno_tpu_decision_duration_seconds', path='sync') >= 1
+        assert registry.histogram_count(
+            'kyverno_tpu_decision_device_share_seconds') >= 1
+
+    def test_shed_records_match_ledger(self, chain, prov):
+        """Overflow sheds: each shed decision records shed:<reason>
+        once, and per-reason record counts equal the shed ledger's."""
+        server, handlers = chain
+        recorder, _registry = prov
+        prior = handlers._batcher
+        handlers._batcher = AdmissionBatcher(
+            window_ms=50, queue_cap=2,
+            on_success=handlers._batch_scan_ok,
+            on_failure=handlers._batch_scan_failed)
+        try:
+            requests = mixed_requests(24, prefix='q')
+            drive(server, requests, n_threads=12)
+            records = recorder.records()
+            assert len(records) == len(requests)
+            shed_records = [r for r in records
+                            if r.path.startswith('shed:')]
+            assert shed_records, 'queue_cap=2 under 12 threads must shed'
+            counts = handlers._batcher.sheds.counts()
+            by_reason = {}
+            for r in shed_records:
+                reason = r.path.split(':', 1)[1]
+                assert reason in shed_policy.REASONS
+                by_reason[reason] = by_reason.get(reason, 0) + 1
+            for reason, n in by_reason.items():
+                assert counts.get(reason, 0) == n, (reason, counts)
+            # shed records land in the error ring too
+            assert len(recorder.errors()) == len(shed_records)
+        finally:
+            custom = handlers._batcher
+            if custom is not None and custom is not prior:
+                custom.stop(drain=True)
+            handlers._batcher = prior
+
+
+class TestRescanRecords:
+    def _controller(self, tmp_path):
+        from kyverno_tpu.dclient.client import FakeClient
+        from kyverno_tpu.reports.controllers import (
+            BackgroundScanController, MetadataCache)
+        import os
+        os.environ['KTPU_VERDICT_CACHE_DIR'] = str(tmp_path / 'vc')
+        try:
+            return BackgroundScanController(
+                FakeClient(), [Policy(next(iter(
+                    yaml.safe_load_all(ENFORCE_POLICY))))],
+                cache=MetadataCache())
+        finally:
+            del os.environ['KTPU_VERDICT_CACHE_DIR']
+
+    def test_rescan_rows_batch_then_replay(self, chain, prov, tmp_path):
+        """Tick 1: every row records as a rider of the tick's dense
+        scan (shares sum to its device_eval).  Tick 2 (no churn): every
+        row replays — digest carried, zero device share."""
+        recorder, _registry = prov
+        ctrl = self._controller(tmp_path)
+        pods = [pod({'team': 'x'}, f'rp{i}', uid=f'uid-{i}')
+                for i in range(6)]
+        for p in pods:
+            ctrl.enqueue(p)
+        ctrl.reconcile(now=1000.0)
+        records = recorder.records()
+        assert len(records) == len(pods)
+        assert all(r.path == 'batch' and r.source == 'rescan'
+                   for r in records)
+        [batch_id] = {r.batch_id for r in records}
+        assert batch_id.startswith('rescan')
+        [occ] = {r.occupancy for r in records}
+        assert occ == len(pods)
+        [device_eval_s] = {r.device_eval_s for r in records}
+        assert sum(r.device_share_s for r in records) == \
+            pytest.approx(device_eval_s, rel=1e-9)
+        recorder.reset()
+        ctrl.reset_scan_state()
+        for p in pods:
+            ctrl.enqueue(p)
+        ctrl.reconcile(now=2000.0)
+        replays = recorder.records()
+        assert len(replays) == len(pods)
+        for r in replays:
+            assert r.path == 'cache_replay' and r.source == 'rescan'
+            assert r.verdict_digest
+            assert r.device_share_s == 0.0 and r.device_eval_s == 0.0
+            assert r.uid.startswith('uid-')
+        ctrl.close()
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_error_ring(self):
+        clock = {'t': 100.0}
+        recorder = provenance.FlightRecorder(
+            4, dump_dir=None, now=lambda: clock['t'])
+        for i in range(10):
+            recorder.record(provenance.DecisionRecord(
+                ts=float(i), path='sync', source='admission',
+                uid=f'u{i}', kind='Pod', namespace='', name='',
+                operation='CREATE', duration_s=0.01, queue_wait_s=0.0,
+                batch_id='', occupancy=1, device_share_s=0.0,
+                device_eval_s=0.0, aot_cache='', coverage_ratio=None,
+                fingerprint='', engine_rev='', verdict_digest='',
+                error=''))
+        for i in range(3):
+            recorder.record(provenance.DecisionRecord(
+                ts=float(i), path='shed:deadline', source='admission',
+                uid=f'e{i}', kind='Pod', namespace='', name='',
+                operation='CREATE', duration_s=0.5, queue_wait_s=0.5,
+                batch_id='', occupancy=0, device_share_s=0.0,
+                device_eval_s=0.0, aot_cache='', coverage_ratio=None,
+                fingerprint='', engine_rev='', verdict_digest='',
+                error=''))
+        assert len(recorder.records()) == 4          # ring-bounded
+        assert len(recorder.errors()) == 3           # separate ring
+        stats = recorder.stats()
+        assert stats['total'] == 13                  # counters unbounded
+        assert stats['by_path'] == {'sync': 10, 'shed:deadline': 3}
+        assert recorder.records(limit=2)[-1].uid == 'e2'
+
+    def test_watchdog_and_scan_error_dump(self, tmp_path):
+        """The d2h stall watchdog and a scan error both dump the rings
+        to JSONL; dumps are rate-limited per trigger on the injected
+        clock."""
+        clock = {'t': 1000.0}
+        registry = MetricsRegistry()
+        devtel.configure(registry, stall_threshold_s=30.0)
+        recorder = provenance.configure(
+            registry, flight_n=16, dump_dir=str(tmp_path),
+            now=lambda: clock['t'])
+        try:
+            provenance.record_decision(path='sync', uid='u1',
+                                       duration_s=0.01)
+            # fire the watchdog synchronously (no sleeping): the event
+            # sink chain ends in the flight recorder's dump
+            devtel.watchdog()._fire(45.0, {'chunk_start': 0})
+            [dump1] = recorder.dump_paths
+            assert 'd2h_stall' in dump1
+            lines = [json.loads(x) for x in open(dump1)]
+            assert lines[0]['trigger'] == 'd2h_stall'
+            assert any(e.get('uid') == 'u1' for e in lines[1:])
+            # rate limit: a second stall inside the window is dropped
+            devtel.watchdog()._fire(45.0, {'chunk_start': 1})
+            assert len(recorder.dump_paths) == 1
+            # scan errors are an independent trigger
+            provenance.notify_scan_error(RuntimeError('boom'))
+            assert len(recorder.dump_paths) == 2
+            assert 'scan_error' in recorder.dump_paths[1]
+            # beyond the window the stall trigger fires again
+            clock['t'] += provenance.FlightRecorder.DUMP_MIN_INTERVAL_S \
+                + 1
+            devtel.watchdog()._fire(45.0, {'chunk_start': 2})
+            assert len(recorder.dump_paths) == 3
+        finally:
+            provenance.disable()
+            devtel.disable()
+
+    def test_flight_n_zero_disables(self, monkeypatch):
+        monkeypatch.setenv('KTPU_FLIGHT_N', '0')
+        assert provenance.configure() is None
+        assert not provenance.enabled()
+        # emit sites are no-ops, not errors
+        assert provenance.record_decision(path='sync') is None
+        assert provenance.breakdown() == {}
+
+
+class TestBitIdentity:
+    def test_admission_output_identical_on_off(self, chain):
+        """The same requests produce byte-identical responses with
+        provenance recording and with KTPU_FLIGHT_N=0 — records ride
+        telemetry, never the response."""
+        server, handlers = chain
+        requests = mixed_requests(12, prefix='bi')
+        registry = MetricsRegistry()
+        devtel.configure(registry)
+        provenance.configure(registry, flight_n=256, dump_dir=None)
+        try:
+            with_prov = drive(server, requests, n_threads=4)
+            assert provenance.recorder().stats()['total'] == \
+                len(requests)
+        finally:
+            provenance.disable()
+            devtel.disable()
+        without = drive(server, requests, n_threads=4)
+        assert with_prov == without
+
+    def test_rescan_reports_identical_on_off(self, tmp_path):
+        from kyverno_tpu.dclient.client import FakeClient
+        from kyverno_tpu.reports.controllers import (
+            BackgroundScanController, MetadataCache)
+        policy = Policy(next(iter(yaml.safe_load_all(ENFORCE_POLICY))))
+        pods = [pod({'team': 'x'} if i % 2 else {}, f'bp{i}',
+                    uid=f'buid-{i}') for i in range(4)]
+
+        def run_tick(enabled, sub):
+            import os
+            os.environ['KTPU_VERDICT_CACHE_DIR'] = \
+                str(tmp_path / sub)
+            try:
+                ctrl = BackgroundScanController(FakeClient(), [policy],
+                                                cache=MetadataCache())
+            finally:
+                del os.environ['KTPU_VERDICT_CACHE_DIR']
+            if enabled:
+                provenance.configure(MetricsRegistry(), flight_n=64,
+                                     dump_dir=None)
+            try:
+                for p in pods:
+                    ctrl.enqueue(p)
+                return ctrl.reconcile(now=1234.0)
+            finally:
+                if enabled:
+                    provenance.disable()
+                ctrl.close()
+        on = run_tick(True, 'on')
+        off = run_tick(False, 'off')
+        assert json.dumps(on, sort_keys=True, default=str) == \
+            json.dumps(off, sort_keys=True, default=str)
+
+
+class TestDebugEndpoint:
+    def test_debug_decisions_and_trace_filters(self, prov):
+        from kyverno_tpu.observability.profiling import ProfilingServer
+        recorder, _registry = prov
+        provenance.record_decision(path='sync', uid='d1',
+                                   duration_s=0.01)
+        provenance.record_decision(path='shed:deadline', uid='d2',
+                                   duration_s=0.5)
+        provenance.record_decision(path='cache_replay', uid='d3',
+                                   verdict_digest='abc123')
+        mem = tracing.configure()
+        with tracing.start_span('kyverno/rescan'):
+            pass
+        with tracing.start_span('kyverno/rescan'):
+            pass
+        srv = ProfilingServer(port=0)
+        port = srv.start()
+        try:
+            base = f'http://127.0.0.1:{port}'
+            body = json.loads(urllib.request.urlopen(
+                f'{base}/debug/decisions').read())
+            assert body['enabled'] is True
+            assert body['stats']['total'] == 3
+            assert [d['uid'] for d in body['decisions']] == \
+                ['d1', 'd2', 'd3']
+            assert [d['uid'] for d in body['errors']] == ['d2']
+            assert body['decisions'][2]['verdict_digest'] == 'abc123'
+            limited = json.loads(urllib.request.urlopen(
+                f'{base}/debug/decisions?limit=1').read())
+            assert [d['uid'] for d in limited['decisions']] == ['d3']
+            # /debug/traces filters (flight-recorder follow-ups)
+            spans = mem.find('kyverno/rescan')
+            tid = spans[0].trace_id
+            traces = json.loads(urllib.request.urlopen(
+                f'{base}/debug/traces?trace_id={tid}').read())
+            assert {s['traceId'] for s in traces['spans']} == {tid}
+            one = json.loads(urllib.request.urlopen(
+                f'{base}/debug/traces?limit=1').read())
+            assert len(one['spans']) == 1
+        finally:
+            srv.stop()
+            tracing.disable()

@@ -119,6 +119,81 @@ class TestSpans:
             otlp['startTimeUnixNano'])
 
 
+class TestExporterHealth:
+    def _span(self, tracer, name='s'):
+        span = tracing.Span(tracer, name, None)
+        span.end()
+
+    def test_jsonl_rotation_keeps_current_plus_one(self, tmp_path):
+        """KTPU_TRACE_JSONL_MAX_BYTES: the span file rotates by size —
+        current + one rotated generation, every surviving line valid
+        JSON."""
+        path = tmp_path / 'spans.jsonl'
+        exporter = tracing.JsonlExporter(str(path), max_bytes=600)
+        tracer = tracing.Tracer([exporter])
+        for i in range(40):
+            self._span(tracer, f'rotate-{i}')
+        exporter.close()
+        rotated = tmp_path / 'spans.jsonl.1'
+        assert rotated.exists()
+        assert {p.name for p in tmp_path.iterdir()} == \
+            {'spans.jsonl', 'spans.jsonl.1'}  # exactly one generation
+        for p in (path, rotated):
+            lines = p.read_text().splitlines()
+            assert lines
+            for line in lines:
+                json.loads(line)
+        # newest spans live in the current file
+        names = [json.loads(line)['name']
+                 for line in path.read_text().splitlines()]
+        assert names[-1] == 'rotate-39'
+
+    def test_export_errors_counted_then_exporter_dropped(self):
+        """A raising exporter is counted per failure on the cataloged
+        error series and dropped after the limit — dead exporters are
+        visible, not silent."""
+        from kyverno_tpu.observability.metrics import (
+            MetricsRegistry, set_global_registry)
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            def broken(span):
+                raise RuntimeError('collector gone')
+            tracer = tracing.Tracer([broken])
+            for _ in range(tracing.EXPORT_FAILURE_LIMIT + 5):
+                self._span(tracer)
+            assert registry.counter_value(
+                tracing.TRACE_EXPORT_ERRORS,
+                exporter='function') == tracing.EXPORT_FAILURE_LIMIT
+            assert broken not in tracer.exporters
+        finally:
+            set_global_registry(None)
+
+    def test_jsonl_write_failure_counted(self, tmp_path):
+        """A JsonlExporter whose file dies closes itself and the
+        tracer counts the failure instead of swallowing it."""
+        from kyverno_tpu.observability.metrics import (
+            MetricsRegistry, set_global_registry)
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            exporter = tracing.JsonlExporter(str(tmp_path / 'x.jsonl'))
+            tracer = tracing.Tracer([exporter])
+            self._span(tracer)  # healthy write
+            exporter._file.close()  # simulate the fd dying
+            self._span(tracer)
+            assert registry.counter_value(
+                tracing.TRACE_EXPORT_ERRORS,
+                exporter='JsonlExporter') == 1
+            # closed exporter is now a cheap no-op, not a raiser
+            self._span(tracer)
+            assert registry.counter_value(
+                tracing.TRACE_EXPORT_ERRORS,
+                exporter='JsonlExporter') == 1
+        finally:
+            set_global_registry(None)
+
+
 class TestProfiling:
     def test_endpoints(self, mem):
         srv = ProfilingServer(port=0)
