@@ -85,6 +85,68 @@ spec:
                     cpu: "?*"
 """
 
+# mutate-heavy pack for the device-side mutate ratchet
+# (kyverno_tpu/mutate/): every policy lowers to edit-site programs —
+# the set is all-or-nothing (plan.py), so one unlowerable rule would
+# zero the ratio — while a fraction of the generated pods trips the
+# per-row FALLBACK paths (json6902 replace on a missing path, non-map
+# intermediates), keeping the attributed-host machinery honest.
+MUTATE_PACK = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-default-labels
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: add-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              "+(team)": platform
+              "+(cost-center)": eng-42
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: set-dns-policy
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: dns
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          spec:
+            dnsPolicy: ClusterFirst
+            "+(enableServiceLinks)": false
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: stamp-annotations
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: stamp
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchesJson6902: |-
+          - op: add
+            path: /metadata/annotations/managed-by
+            value: kyverno-tpu
+          - op: replace
+            path: /metadata/annotations/tier
+            value: gold
+"""
+
+#: device-coverage ratchet for ``bench.py --mutate-pack``: the mutate
+#: rows' device ratio must not regress below this committed floor
+#: (~10% of generated pods deliberately trip per-row FALLBACK)
+MUTATE_DEVICE_RATIO_FLOOR = 0.75
+
 _IMAGES = ['nginx:1.25.3', 'nginx:latest', 'ghcr.io/org/app:v2.1',
            'redis:7', 'docker.io/library/busybox', 'gcr.io/proj/svc:prod',
            'app', 'registry.internal:5000/team/api:canary']
@@ -1132,6 +1194,187 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
     return block
 
 
+def make_mutate_pod(rng, i: int) -> dict:
+    """Pods for the mutate-heavy pack: ~90% carry the ``tier``
+    annotation the json6902 replace needs (the rest FALLBACK per row,
+    attributed ``replace_path_missing``), half already carry a ``team``
+    label (the add-only anchor skips), and dnsPolicy varies so the
+    strategic merge sometimes edits, sometimes SKIPs."""
+    meta = {'name': f'pod-{i}', 'namespace': f'ns-{i % 7}'}
+    annotations = {'owner': f'team-{i % 5}'}
+    if rng.random() < 0.9:
+        annotations['tier'] = rng.choice(['bronze', 'silver', 'gold'])
+    meta['annotations'] = annotations
+    if rng.random() < 0.5:
+        meta['labels'] = {'team': rng.choice(['red', 'blue'])}
+    spec = {'containers': [{'name': 'c', 'image': 'nginx:1.25.3'}]}
+    if rng.random() < 0.5:
+        spec['dnsPolicy'] = 'Default'
+    return {'apiVersion': 'v1', 'kind': 'Pod', 'metadata': meta,
+            'spec': spec}
+
+
+def load_mutate_pack():
+    import yaml
+    from kyverno_tpu.api.policy import Policy
+    return [Policy(d) for d in yaml.safe_load_all(MUTATE_PACK) if d]
+
+
+def run_mutate_bench(n: int, platform: str) -> dict:
+    """``bench.py --mutate-pack``: the device-side mutate ratchet.
+
+    Scans ``n`` pods through the compiled mutate edit-list path with
+    the host engine chain as the byte-identity oracle on a sample,
+    drives the /mutate webhook with concurrent batch-mode clients
+    (occupancy must exceed 1 — mutate requests coalesce), and asserts
+    ``device_coverage_ratio`` over the mutate rows never regresses
+    below ``MUTATE_DEVICE_RATIO_FLOOR``."""
+    import json as _json
+    import random
+    import threading
+    from kyverno_tpu.engine.api import PolicyContext
+    from kyverno_tpu.engine.engine import Engine
+    from kyverno_tpu.mutate import MutateScanner
+    from kyverno_tpu.observability import coverage as coverage_ledger
+
+    policies = load_mutate_pack()
+    rng = random.Random(7)
+    pods = [make_mutate_pod(rng, i) for i in range(n)]
+    scanner = MutateScanner(policies)
+    if not scanner.ok:
+        raise AssertionError(
+            'mutate pack failed to lower: '
+            + '; '.join(f'{p.policy}/{p.rule}: {p.reason}'
+                        for p in scanner.program.placements
+                        if p.reason))
+    t0 = time.time()
+    rows = scanner.scan([dict(p) for p in pods])
+    scan_s = time.time() - t0
+
+    # host-oracle: the engine's cumulative chain, byte for byte
+    engine = Engine()
+    sample = rng.sample(range(n), min(64, n))
+    for i in sample:
+        pctx = PolicyContext(None, new_resource=_json.loads(
+            _json.dumps(pods[i])))
+        host = []
+        for pol in policies:
+            ctx = pctx.copy()
+            ctx.policy = pol
+            er = engine.mutate(ctx)
+            host.append((pol.name, er))
+            if not er.is_successful():
+                break
+            pctx = pctx.copy()
+            pctx.new_resource = er.patched_resource or pctx.new_resource
+            pctx.json_context.add_resource(pctx.new_resource)
+        steps, patched = rows[i]
+        if _json.dumps(patched, sort_keys=True) != \
+                _json.dumps(pctx.new_resource, sort_keys=True):
+            raise AssertionError(f'row {i}: patched doc diverged from '
+                                 f'the host oracle')
+        for (hname, her), (dpol, der) in zip(host, steps):
+            hcells = [(r.name, str(r.status), r.message, r.patches)
+                      for r in her.policy_response.rules]
+            dcells = [(r.name, str(r.status), r.message, r.patches)
+                      for r in der.policy_response.rules]
+            if hcells != dcells:
+                raise AssertionError(
+                    f'row {i} policy {hname}: device cells diverged '
+                    f'from the host oracle')
+    _progress(f'mutate oracle: {len(sample)} rows byte-identical')
+
+    # concurrent /mutate webhook drive: batch serving must coalesce
+    from kyverno_tpu.policycache.cache import Cache
+    from kyverno_tpu.webhooks.handlers import ResourceHandlers
+    from kyverno_tpu.webhooks.server import WebhookServer
+    from kyverno_tpu.policycache import cache as pcache
+    cache = Cache()
+    cache.warm_up(policies)
+    handlers = ResourceHandlers(cache, serving_mode='batch')
+    server = WebhookServer(handlers)
+    mut_policies = cache.get_policies(pcache.MUTATE, 'Pod', 'ns-0')
+    deadline = time.time() + float(
+        os.environ.get('BENCH_ADMISSION_WAIT_S', '90'))
+    msc = None
+    while time.time() < deadline:
+        msc = handlers._device_scanner(mut_policies, kind='mutate')
+        if msc is not None:
+            break
+        time.sleep(0.05)
+    device_served = bool(msc is not None and msc.ok)
+    n_threads, per_thread = 8, 8
+    barrier = threading.Barrier(n_threads)
+    statuses: List[int] = []
+
+    def work(tid):
+        barrier.wait()
+        for k in range(per_thread):
+            doc = pods[(tid * per_thread + k) % len(pods)]
+            review = _json.loads(_admission_review(doc, f'm{tid}-{k}'))
+            review['request']['namespace'] = \
+                doc['metadata'].get('namespace', '')
+            _out, status = server.handle_request(
+                '/mutate', _json.dumps(review).encode())
+            statuses.append(status)
+
+    threads = [threading.Thread(target=work, args=(tid,))
+               for tid in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    stats = handlers._get_batcher().stats()
+    handlers.shutdown()
+    if any(s != 200 for s in statuses):
+        raise AssertionError(f'non-200 mutate responses: {statuses}')
+
+    cov = coverage_ledger.bench_block() or {}
+    ledger = coverage_ledger.ledger()
+    mutate_device = mutate_host = 0
+    if ledger is not None:
+        for rec in ledger.report()['rules']:
+            if rec['path'] == 'mutate':
+                mutate_device += rec['device_rows']
+                mutate_host += rec['host_rows']
+    mutate_rows = mutate_device + mutate_host
+    ratio = (mutate_device / mutate_rows) if mutate_rows else 0.0
+    # THE RATCHET: device coverage of mutate rows must not regress
+    if ratio < MUTATE_DEVICE_RATIO_FLOOR:
+        raise AssertionError(
+            f'mutate device_coverage_ratio {ratio:.4f} regressed below '
+            f'the committed floor {MUTATE_DEVICE_RATIO_FLOOR}')
+    return {
+        'metric': 'mutate_device_scan_rows_per_sec',
+        'value': round(n / scan_s, 1) if scan_s > 0 else 0.0,
+        'unit': 'rows/s', 'platform': platform, 'n': n,
+        'n_policies': len(policies),
+        'oracle_rows': len(sample),
+        'mutate_webhook': {
+            'device_served': device_served,
+            'batch_occupancy_mean': round(stats['occupancy_mean'], 2),
+            'batch_occupancy_p50': stats['occupancy_p50'],
+            'shed_total': stats['shed_total'],
+            'requests': stats['requests'],
+        },
+        'coverage': dict(
+            cov, mutate_rows=mutate_rows,
+            mutate_device_rows=mutate_device,
+            mutate_host_rows=mutate_host,
+            mutate_device_coverage_ratio=round(ratio, 4),
+            ratchet_floor=MUTATE_DEVICE_RATIO_FLOOR),
+    }
+
+
+def mutate_bench_main(platform: str) -> int:
+    """``bench.py --mutate-pack [N]``: run only the device-side mutate
+    ratchet (CI-sized; BENCH_MUTATE_N rows, default 2000)."""
+    n = int(os.environ.get('BENCH_MUTATE_N', '2000'))
+    result = run_mutate_bench(n, platform)
+    print(json.dumps(result))
+    return 0
+
+
 def rescan_churn_main(platform: str, args: List[str]) -> int:
     """``bench.py --churn-ticks N [--churn-ratio R]``: run only the
     rescan churn bench (full scale: BENCH_RESCAN_N rows, default
@@ -1212,6 +1455,17 @@ def main() -> int:
             traceback.print_exc()
             print(json.dumps({
                 'metric': 'admission_concurrency', 'platform': platform,
+                'error': f'{type(e).__name__}: {e}'}))
+            return 1
+    if '--mutate-pack' in sys.argv[1:]:
+        try:
+            return mutate_bench_main(platform)
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': 'mutate_device_scan_rows_per_sec',
+                'platform': platform,
                 'error': f'{type(e).__name__}: {e}'}))
             return 1
     if '--churn-ticks' in sys.argv[1:] or '--churn-ratio' in sys.argv[1:]:

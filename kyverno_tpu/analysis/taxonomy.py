@@ -13,8 +13,9 @@ Both are program-structure properties — enforced here, statically.
   literals and ``REASON_*`` constant references are both resolved).
 * **KTPU302** — a bare ``return <SENTINEL>`` (``FALLBACK`` /
   ``_HOST_MARKER`` — any module-level ``X = object()`` sentinel) in a
-  ``compiler/`` file whose enclosing function never attributes a
-  reason: the fallback escapes the ledger.
+  ``compiler/`` or ``mutate/`` (device-side mutate) file whose
+  enclosing function never attributes a reason: the fallback escapes
+  the ledger.
 * **KTPU303** — dead reason: a taxonomy member no site ever raises
   (mirrors the dead-metric pass).
 """
@@ -194,13 +195,19 @@ def _attributes_reason(fn: ast.AST) -> bool:
     return False
 
 
-@register('KTPU302', 'unattributed host-fallback site in compiler/ '
-                     '(bare sentinel return with no taxonomy reason)')
+@register('KTPU302', 'unattributed host-fallback site in compiler/ or '
+                     'mutate/ (bare sentinel return with no taxonomy '
+                     'reason)')
 def _check_unattributed_fallback(ctx: Context) -> Iterable[Finding]:
     sentinels = _sentinel_names(ctx)
     graph = jit_graph(ctx)
     for rel, mi in graph.modules.items():
-        if 'compiler' not in rel.replace(os.sep, '/').split('/'):
+        parts = rel.replace(os.sep, '/').split('/')
+        # compiler/ plus the device-side mutate package (its lowering
+        # shares the FALLBACK discipline); engine/mutate/ is the host
+        # oracle and carries no sentinels
+        if 'compiler' not in parts and \
+                not ('mutate' in parts and 'engine' not in parts):
             continue
         for defs in mi.defs.values():
             for fn in defs:

@@ -108,15 +108,20 @@ def _compile_overlay(overlay: Any) -> Optional[List[Tuple[Tuple[str, ...],
     return out
 
 
-def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
+def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]],
+                rule_name: str = '', policy_name: str = ''):
     """Copy-on-write application of flattened scalar sets; returns
-    (changed, patched) or FALLBACK on a non-dict intermediate."""
+    (changed, patched) or FALLBACK on a non-dict intermediate.  Every
+    escape is attributed on the coverage ledger at its decision site —
+    the three returns below each name their reason via ``_fallback`` —
+    so callers propagate the sentinel without re-recording."""
     changes = []
     for path, add_only, value in sets:
         cur: Any = doc
         for part in path[:-1]:
             if not isinstance(cur, dict):
-                return FALLBACK
+                # the overlay path descends through a non-map value
+                return _fallback(REASON_NON_DICT, rule_name, policy_name)
             cur = cur.get(part)
             if cur is None:
                 break
@@ -126,7 +131,8 @@ def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
             changes.append((path, value))
             continue
         if not isinstance(cur, dict):
-            return FALLBACK
+            # the leaf's parent container is a non-map value
+            return _fallback(REASON_NON_DICT, rule_name, policy_name)
         if leaf in cur:
             if not add_only and cur[leaf] != value:
                 changes.append((path, value))
@@ -134,7 +140,23 @@ def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
             changes.append((path, value))
     if not changes:
         return False, doc
+    patched = apply_edit_list(doc, changes)
+    if patched is None:
+        # copy-on-write hit a non-map while rebuilding the path
+        return _fallback(REASON_NON_DICT, rule_name, policy_name)
+    return True, patched
 
+
+def apply_edit_list(doc: dict,
+                    changes: List[Tuple[Tuple[str, ...], Any]]):
+    """Copy-on-write application of a DECIDED (path, value) edit list —
+    the patch phase shared by ``_apply_sets`` and the device-mutate
+    decode (``kyverno_tpu/mutate/scanner.py``, which reads the edit
+    bitmask back from the device and materializes it here).  Returns
+    the patched document, or None when a non-map parent appears while
+    rebuilding a path (callers attribute the escape)."""
+    if not changes:
+        return doc
     patched = dict(doc)
     copied: Dict[Tuple[str, ...], dict] = {(): patched}
 
@@ -154,9 +176,9 @@ def _apply_sets(doc: dict, sets: List[Tuple[Tuple[str, ...], bool, Any]]):
     for path, value in changes:
         parent = cow(path[:-1])
         if parent is None:
-            return FALLBACK
+            return None
         parent[path[-1]] = value
-    return True, patched
+    return patched
 
 
 def compile_strategic_merge(overlay: Any, rule_name: str = '',
@@ -167,9 +189,9 @@ def compile_strategic_merge(overlay: Any, rule_name: str = '',
         return None
 
     def apply(doc: dict):
-        result = _apply_sets(doc, sets)
+        result = _apply_sets(doc, sets, rule_name, policy_name)
         if result is FALLBACK:
-            return _fallback(REASON_NON_DICT, rule_name, policy_name)
+            return result  # attributed at the _apply_sets decision site
         changed, patched = result
         if not changed:
             return (RuleStatus.SKIP, 'no patches applied', False, doc)
@@ -180,8 +202,13 @@ def compile_strategic_merge(overlay: Any, rule_name: str = '',
 
 # -- static json6902 --------------------------------------------------------
 
-def compile_json6902(patch_text: Any, rule_name: str = '',
-                     policy_name: str = '') -> Optional[CompiledMutation]:
+def parse_json6902_sets(patch_text: Any):
+    """``(sets, replace_paths)`` for a static add/replace object-path
+    json6902 patch, or None when the shape leaves the fast vocabulary
+    (array indexes, other ops, variables, unparseable text).  Shared by
+    :func:`compile_json6902` and the device-mutate lowering
+    (``kyverno_tpu/mutate/plan.py``) so the two paths can never accept
+    different patch grammars."""
     from ..engine.mutate.mutate import _load_patches_cached
     if not isinstance(patch_text, str) or '{{' in patch_text:
         return None
@@ -205,6 +232,15 @@ def compile_json6902(patch_text: Any, rule_name: str = '',
         if op_name == 'replace':
             replace_paths.append(parts)
         sets.append((parts, False, op.get('value')))
+    return sets, replace_paths
+
+
+def compile_json6902(patch_text: Any, rule_name: str = '',
+                     policy_name: str = '') -> Optional[CompiledMutation]:
+    parsed = parse_json6902_sets(patch_text)
+    if parsed is None:
+        return None
+    sets, replace_paths = parsed
 
     def apply(doc: dict):
         # `replace` requires the leaf AND every intermediate to exist —
@@ -218,9 +254,9 @@ def compile_json6902(patch_text: Any, rule_name: str = '',
                     return _fallback(REASON_REPLACE_PATH_MISSING,
                                      rule_name, policy_name)
                 cur = cur[part]
-        result = _apply_sets(doc, sets)
+        result = _apply_sets(doc, sets, rule_name, policy_name)
         if result is FALLBACK:
-            return _fallback(REASON_NON_DICT, rule_name, policy_name)
+            return result  # attributed at the _apply_sets decision site
         changed, patched = result
         if not changed:
             return (RuleStatus.SKIP, 'no patches applied', False, doc)
@@ -364,9 +400,9 @@ def compile_foreach(foreach_list: Any, rule: dict,
                                  policy_name)
             if not passed:
                 continue
-            result = _apply_sets(element, elem_sets)
+            result = _apply_sets(element, elem_sets, rule_name, policy_name)
             if result is FALLBACK:
-                return _fallback(REASON_NON_DICT, rule_name, policy_name)
+                return result  # attributed at the _apply_sets decision site
             changed, patched_elem = result
             if changed:
                 if new_list is None:

@@ -332,6 +332,33 @@ class BatchScanner:
                 match[i, j] = self._match_one(int(j), wrapped[i], adm3)
         return match
 
+    def _fold_old_matches(self, match: np.ndarray,
+                          wrapped: List[Resource],
+                          admission: Optional[tuple],
+                          old_resources) -> np.ndarray:
+        """UPDATE-verb match semantics folded into the sieve: the engine
+        retries a failed new-object match against the old object
+        (engine.py:303 ``_matches``), and a namespaced policy applies
+        only when BOTH objects sit in its namespace (engine.py:239).
+        Rows are admission-sized (≤ the batch cap), so the per-(row,
+        program) host walk here is noise next to the device dispatch."""
+        adm3 = admission[:3] if admission else None
+        match = match.copy()
+        progs = self.cps.programs
+        for i, old in enumerate(old_resources):
+            if not old:
+                continue
+            ores = Resource(old)
+            for j in range(len(progs)):
+                if not match[i, j]:
+                    match[i, j] = self._match_one(j, ores, adm3)
+                if match[i, j]:
+                    policy = self.policies[progs[j].policy_index]
+                    if not (self._policy_gate(policy, wrapped[i]) and
+                            self._policy_gate(policy, ores)):
+                        match[i, j] = False
+        return match
+
     # -- device evaluation --------------------------------------------------
 
     #: fixed device-chunk size: XLA compiles the evaluator once per
@@ -574,7 +601,9 @@ class BatchScanner:
     def scan(self, resources: List[dict],
              contexts: Optional[List[dict]] = None,
              admission: Optional[tuple] = None,
-             pctx_factory=None) -> List[List[EngineResponse]]:
+             pctx_factory=None,
+             old_resources: Optional[List[Optional[dict]]] = None
+             ) -> List[List[EngineResponse]]:
         """Return, per resource, the engine responses of all policies with
         at least one applicable rule (host-identical).
 
@@ -582,14 +611,20 @@ class BatchScanner:
         resource), ``admission`` (admission_info, exclude_group_roles,
         namespace_labels, operation) for match semantics, and
         ``pctx_factory(doc)`` so host materialization sees the same
-        PolicyContext the engine loop would build."""
+        PolicyContext the engine loop would build.  UPDATE-verb rows
+        additionally carry their ``oldObject`` in ``old_resources``
+        (row-aligned, None for rows without one): the engine retries a
+        failed new-object match against the old object, so the host
+        match sieve must too — evaluation itself stays on the new
+        object, exactly like the engine."""
         return list(self.scan_stream(resources, contexts, admission,
-                                     pctx_factory))
+                                     pctx_factory, old_resources))
 
     def scan_stream(self, resources: List[dict],
                     contexts: Optional[List[dict]] = None,
                     admission: Optional[tuple] = None,
-                    pctx_factory=None):
+                    pctx_factory=None,
+                    old_resources: Optional[List[Optional[dict]]] = None):
         """Generator form of ``scan``: yields each resource's responses
         in order as its device chunk completes.  Consumers that do
         per-resource work (report construction, CR writes) overlap it
@@ -598,9 +633,10 @@ class BatchScanner:
         if not resources:
             return
         yield from self._scan_inner(resources, contexts, admission,
-                                    pctx_factory)
+                                    pctx_factory, old_resources)
 
-    def _scan_inner(self, resources, contexts, admission, pctx_factory):
+    def _scan_inner(self, resources, contexts, admission, pctx_factory,
+                    old_resources=None):
         n = len(resources)
         self._pctx_factory = pctx_factory
         # context-load outcomes are memoized within one scan pass only —
@@ -612,6 +648,9 @@ class BatchScanner:
         background_mode = admission is None and pctx_factory is None
         wrapped = [Resource(r) for r in resources]
         match = self.match_matrix(resources, wrapped, admission)
+        if old_resources is not None and any(old_resources):
+            match = self._fold_old_matches(match, wrapped, admission,
+                                           old_resources)
         now = time.time()
         ts = int(now)
 
@@ -622,7 +661,8 @@ class BatchScanner:
         # operations entirely, and roles/subjects rules are non-simple),
         # and a screened-out policy contributes the same empty response
         # the engine would produce.
-        host_maybe = self._host_policy_maybe(resources, wrapped)
+        host_maybe = self._host_policy_maybe(resources, wrapped,
+                                             old_resources)
 
         progs = self.cps.programs
         background_ok = getattr(self, '_background_ok', None)
@@ -1027,11 +1067,18 @@ class BatchScanner:
             self._host_rules_cache = cached
         return cached
 
-    def _host_policy_maybe(self, resources, wrapped):
+    def _host_policy_maybe(self, resources, wrapped, old_resources=None):
         """Per host policy: bool[R] 'any rule may match', or None when the
-        policy has non-simple rules (always run)."""
+        policy has non-simple rules (always run).  UPDATE rows OR in the
+        old object's screen — the engine's old-match retry means a rule
+        matching only the old object still runs, so screening it out
+        would drop a response the engine would have produced (the screen
+        may only over-approximate)."""
         maybe: Dict[int, Optional[np.ndarray]] = {}
         group_of = [_group_key(doc) for doc in resources]
+        old_wrapped = {
+            i: Resource(o) for i, o in enumerate(old_resources or [])
+            if o}
         host_rules = self._host_policy_rules()
         for p_idx in self._host_policy_idx:
             policy = self.policies[p_idx]
@@ -1040,16 +1087,21 @@ class BatchScanner:
                 maybe[p_idx] = None
                 continue
             cache: Dict[Tuple, bool] = {}
+
+            def screen(res, _policy=policy, _robj=robj):
+                return self._policy_gate(_policy, res) and any(
+                    matches_resource_description(
+                        res, r, None, [], {}, '') is None
+                    for r in _robj)
+
             flags = np.zeros(len(resources), bool)
             for i, key in enumerate(group_of):
                 hit = cache.get(key)
                 if hit is None:
-                    res = wrapped[i]
-                    hit = self._policy_gate(policy, res) and any(
-                        matches_resource_description(
-                            res, r, None, [], {}, '') is None
-                        for r in robj)
+                    hit = screen(wrapped[i])
                     cache[key] = hit
+                if not hit and i in old_wrapped:
+                    hit = screen(old_wrapped[i])
                 flags[i] = hit
             maybe[p_idx] = flags
         return maybe

@@ -6,7 +6,6 @@ pkg/engine/mutate/mutation.go (Mutate/ForEach handlers).
 
 from __future__ import annotations
 
-import copy
 import time
 from typing import Any, List, Optional
 
@@ -98,9 +97,14 @@ def _apply_patcher(mutation: dict, resource: dict, ctx: Context) -> MutateRespon
 
 def _apply_strategic_merge(overlay: Any, resource: dict) -> MutateResponse:
     # reference: pkg/engine/mutate/patch/strategicMergePatch.go:18
+    # preprocess_pattern never mutates the overlay (strategic.py module
+    # note), so the rule-constant tree applies per resource without a
+    # deepcopy; the patched output may alias overlay subtrees — the
+    # substitute_all read-only contract downstream consumers already
+    # honor
     try:
         try:
-            processed = preprocess_pattern(copy.deepcopy(overlay), resource)
+            processed = preprocess_pattern(overlay, resource)
         except (ConditionError, GlobalConditionError):
             processed = {}
         patched = strategic_merge(resource, processed)

@@ -19,7 +19,6 @@ Two stages, mirroring the reference:
 
 from __future__ import annotations
 
-import copy
 from typing import Any, List, Optional, Tuple
 
 from .. import anchor
@@ -41,17 +40,30 @@ ASSOCIATIVE_KEYS = ('mountPath', 'devicePath', 'ip', 'type', 'topologyKey',
 
 def apply_strategic_merge_patch(base: Any, overlay: Any) -> Any:
     """Preprocess the overlay against base, then merge. Returns the patched
-    document; on a failed condition returns base unchanged."""
-    overlay = copy.deepcopy(overlay)
+    document; on a failed condition returns base unchanged.  Neither
+    input is mutated: preprocessing rebuilds containers as it walks and
+    the merge is copy-on-write, so the output may structurally SHARE
+    unpatched subtrees with both inputs (the ``substitute_all`` aliasing
+    contract — treat outputs read-only or copy before mutating)."""
     try:
         overlay = preprocess_pattern(overlay, base)
     except (ConditionError, GlobalConditionError):
-        return copy.deepcopy(base)
+        return base
     return strategic_merge(base, overlay)
 
 
 # ---------------------------------------------------------------------------
 # Stage 1: preprocessing
+#
+# The whole walk is NON-MUTATING toward ``pattern``: every map level is
+# rebuilt before being written to (`_handle_add_if_not_present` /
+# `_delete_anchors_in_map` return fresh dicts; `_validate_conditions`
+# only ever writes into the fresh dict `_walk_map` just made), so
+# callers apply rule-constant overlays per resource WITHOUT a deepcopy
+# — the per-(resource, element) deepcopy used to dominate bulk mutate
+# profiles the same way `calculate_resource_hash`'s did (PR 6).
+# tests/test_mutate.py pins both the no-mutation property and output
+# identity against a deepcopy-based reference.
 
 def preprocess_pattern(pattern: Any, resource: Any) -> Any:
     pattern = _preprocess_recursive(pattern, resource)
@@ -102,11 +114,13 @@ def _process_list_of_maps(pattern: list, resource: Any) -> list:
             continue
         any_global_passed = False
         last_global_error: Optional[GlobalConditionError] = None
-        element_copy = copy.deepcopy(pattern_element)
+        # the recursive walk never mutates its pattern argument (module
+        # note above), so one shared pattern_element serves every
+        # resource element — no per-(resource, element) deepcopy
         for resource_element in resource_elements:
             try:
-                processed = _preprocess_recursive(
-                    copy.deepcopy(element_copy), resource_element)
+                processed = _preprocess_recursive(pattern_element,
+                                                  resource_element)
             except ConditionError:
                 continue
             except GlobalConditionError as e:
@@ -120,7 +134,7 @@ def _process_list_of_maps(pattern: list, resource: Any) -> list:
                     out.append(new_elem)
         if not resource_elements:
             try:
-                _preprocess_recursive(copy.deepcopy(element_copy), None)
+                _preprocess_recursive(pattern_element, None)
                 if has_global:
                     any_global_passed = True
             except ConditionError:
@@ -139,7 +153,7 @@ def _pattern_with_name(pattern_element: dict, resource_element: Any) -> Optional
     name = resource_element.get('name')
     if not name:
         return None
-    new_node, empty = _delete_anchors(copy.deepcopy(pattern_element),
+    new_node, empty = _delete_anchors(pattern_element,
                                       delete_scalar=True,
                                       traverse_mapping=False)
     if empty or not isinstance(new_node, dict):

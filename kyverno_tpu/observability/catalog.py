@@ -104,6 +104,18 @@ METRICS: Dict[str, Metric] = {
         '(KTPU_AOT_CACHE_DIR).'),
     'kyverno_tpu_aot_cache_entries': Metric(
         'gauge', 'Persisted AOT executable entries on disk.'),
+    # device-side mutate (kyverno_tpu/mutate/scanner.py)
+    'kyverno_tpu_mutate_patch_emit_seconds': Metric(
+        'histogram', 'Mutate patch-emit stage: encode the edit-site '
+        'lanes and run the device kernel that decides per-(resource, '
+        'rule) edit bitmasks.'),
+    'kyverno_tpu_mutate_decode_seconds': Metric(
+        'histogram', 'Mutate decode stage: edit bitmasks back to '
+        '(slot, value) edit lists, copy-on-write patch application, '
+        'and EngineResponse assembly on the host.'),
+    'kyverno_tpu_mutate_device_edits_total': Metric(
+        'counter', 'Individual edits applied from device-decided '
+        'mutate edit lists.'),
     # decision provenance (observability/provenance.py)
     'kyverno_tpu_decision_duration_seconds': Metric(
         'histogram', 'End-to-end per-decision latency by serving '
@@ -143,6 +155,12 @@ SPANS: Dict[str, str] = {
     'kyverno/device/d2h': 'Device-to-host readback stage (stall-'
                           'watchdog armed).',
     'kyverno/device/report': 'Response/report assembly stage.',
+    'kyverno/mutate/patch_emit': 'Device mutate patch-emit stage: '
+                                 'edit-site lane encode + kernel '
+                                 'dispatch for one batch.',
+    'kyverno/mutate/decode': 'Device mutate decode stage: edit '
+                             'bitmasks to patched JSON + engine '
+                             'responses.',
     'kyverno/rescan': 'One background reconcile tick (verdict-cache '
                       'filter + dense scan of the misses).',
     'kyverno/background/ur': 'One UpdateRequest sync.',

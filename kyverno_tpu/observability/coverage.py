@@ -69,12 +69,20 @@ REASON_REPLACE_PATH_MISSING = 'replace_path_missing'  # json6902 replace
 #   on a path the document does not have
 REASON_PRECONDITION_ESCAPE = 'precondition_escape'  # per-element
 #   precondition left the compiled vocabulary at runtime
+# Device-side mutate (kyverno_tpu/mutate/):
+REASON_SITE_CONFLICT = 'edit_site_conflict'  # two lowered mutate rules
+#   write overlapping slot paths — cumulative ordering leaves the
+#   original-document device vocabulary (compile time)
+REASON_PATCH_UNDECIDABLE = 'patch_undecidable'  # the encoded lanes
+#   cannot decide whether the live value equals the patch constant
+#   (numeric outside the exact milli window) — host applies instead
 
 REASONS = frozenset({
     REASON_UNSUPPORTED_OPERATOR, REASON_HOST_CLOSURE, REASON_API_CALL,
     REASON_POLICY_COUPLING, REASON_STATUS_HOST, REASON_UNSYNTHESIZABLE,
     REASON_CONTEXT_LOAD, REASON_NON_DICT, REASON_DUP_ELEMENT_NAMES,
     REASON_REPLACE_PATH_MISSING, REASON_PRECONDITION_ESCAPE,
+    REASON_SITE_CONFLICT, REASON_PATCH_UNDECIDABLE,
 })
 
 
@@ -141,6 +149,11 @@ class ScanTally:
 
     @staticmethod
     def _path(prog) -> str:
+        # device-mutate programs carry an explicit .path ('mutate');
+        # validate RulePrograms are distinguished by their PSS payload
+        explicit = getattr(prog, 'path', None)
+        if explicit:
+            return explicit
         return 'pss' if prog.pss is not None else 'validate'
 
     def device(self, prog) -> None:

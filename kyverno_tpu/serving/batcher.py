@@ -103,15 +103,21 @@ class AdmissionBatcher:
     # -- submission (webhook threads) -------------------------------------
 
     def submit(self, resource: dict, context: Optional[dict], pctx,
-               admission: tuple, scanner, policies) -> Ticket:
+               admission: tuple, scanner, policies,
+               old_resource: Optional[dict] = None) -> Ticket:
         """Enqueue one request; raises QueueFull / Stopped (callers shed
         to the host loop).  The current span rides along so the batch
-        span nests under the request's HTTP-handler span."""
+        span nests under the request's HTTP-handler span.  The key
+        includes the scanner identity, so validate and mutate tickets —
+        and distinct verbs, via the admission tuple's operation — never
+        share a dispatch; UPDATE tickets carry their oldObject for the
+        scanner's old-match retry."""
         ticket = Ticket(
             key=(id(scanner), admission_key(admission)),
             resource=resource, context=context, pctx=pctx,
             admission=admission, scanner=scanner, policies=policies,
-            span=tracing.current_span(), on_shed=self.sheds.record)
+            span=tracing.current_span(), on_shed=self.sheds.record,
+            old_resource=old_resource)
         self.queue.put(ticket)
         self._set_depth()
         return ticket
@@ -155,6 +161,12 @@ class AdmissionBatcher:
         # scan (not a registry-sum delta a concurrent rescan could
         # contaminate) amortizes over the riders as their device share
         cap = devtel.ScanCapture() if provenance.enabled() else None
+        # UPDATE rows carry oldObject for the scanner's match retry; the
+        # kwarg is only passed when present so CREATE-era scanner
+        # doubles (and the mutate scanner) keep their signatures
+        extra = {}
+        if any(t.old_resource for t in batch):
+            extra['old_resources'] = [t.old_resource for t in batch]
         try:
             with devtel.install_capture(cap), \
                     tracing.tracer().start_span(
@@ -164,7 +176,7 @@ class AdmissionBatcher:
                         parent=lead.span):
                 rows = scanner.scan(resources, contexts=contexts,
                                     admission=lead.admission,
-                                    pctx_factory=pctx_factory)
+                                    pctx_factory=pctx_factory, **extra)
         except Exception as e:  # noqa: BLE001 - riders shed, never a 500
             for t in batch:
                 t.shed(shed_policy.REASON_SCAN_ERROR)

@@ -51,15 +51,19 @@ class Ticket:
 
     __slots__ = ('key', 'resource', 'context', 'pctx', 'admission',
                  'scanner', 'policies', 'span', 'on_shed', 'enqueued_at',
-                 'state', 'responses', 'shed_reason', 'prov', '_lock',
-                 '_event')
+                 'state', 'responses', 'shed_reason', 'prov',
+                 'old_resource', '_lock', '_event')
 
     def __init__(self, key, resource: dict, context: Optional[dict],
                  pctx, admission: tuple, scanner, policies,
-                 span=None, on_shed=None):
+                 span=None, on_shed=None,
+                 old_resource: Optional[dict] = None):
         self.key = key
         self.resource = resource
         self.context = context
+        #: UPDATE-verb rows ride their oldObject along for the scanner's
+        #: old-match retry; None on CREATE / mutate tickets
+        self.old_resource = old_resource
         self.pctx = pctx
         self.admission = admission
         self.scanner = scanner

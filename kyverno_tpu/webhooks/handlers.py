@@ -297,12 +297,15 @@ class ResourceHandlers:
         self.event_sink = event_sink
         self.registry_client = registry_client
         # the compiled device evaluator handles enforce validation for
-        # CREATE requests; rebuilt when the cached policy set changes
+        # CREATE/UPDATE requests; rebuilt when the cached policy set
+        # changes
         self.device = device
         self._scanner_lock = threading.Lock()
-        # LRU of compiled scanners keyed per policy set: admission
-        # traffic alternating kinds/namespaces yields different policy
-        # lists and must not rebuild (compile!) per request
+        # LRU of compiled scanners keyed per (kind, policy set): a
+        # policy set can compile both a validate BatchScanner and a
+        # mutate MutateScanner, and admission traffic alternating
+        # kinds/namespaces yields different policy lists which must not
+        # rebuild (compile!) per request
         self._scanners: 'collections.OrderedDict[tuple, Any]' = \
             collections.OrderedDict()
         self._scanners_max = 8
@@ -322,12 +325,18 @@ class ResourceHandlers:
         self._dead_keys: 'collections.OrderedDict[tuple, Any]' = \
             collections.OrderedDict()
         self._breaker_cap = 64
-        # admission serving mode: 'batch' routes CREATE-path validate
-        # scans through the micro-batching scheduler (serving/), 'sync'
-        # keeps the per-request dispatch
+        # admission serving mode: 'batch' routes CREATE/UPDATE-path
+        # validate AND mutate scans through the micro-batching scheduler
+        # (serving/), 'sync' keeps the per-request dispatch
         import os as _os
         self.serving_mode = serving_mode or \
             _os.environ.get('KTPU_SERVING', 'sync')
+        # device-side mutate (kyverno_tpu/mutate/): lowered strategic-
+        # merge / json6902 policy sets serve the admission mutate chain
+        # as batched device dispatches; 0 keeps every mutate request on
+        # the host engine loop (the bit-identity oracle)
+        self.mutate_device = _os.environ.get(
+            'KTPU_MUTATE_DEVICE', '1') not in ('0', 'false', 'off')
         self._batcher = None
         self._batcher_lock = threading.Lock()
 
@@ -335,22 +344,28 @@ class ResourceHandlers:
     def _policy_key(policies):
         return tuple(id(p) for p in policies)
 
-    def _device_scanner(self, policies):
+    def _device_scanner(self, policies, kind: str = 'validate'):
         """Scanner for ``policies``, or None while one is still compiling.
 
-        Building a BatchScanner pays jax trace + XLA compile (seconds to
-        minutes on a policy-set change); doing that on the request path
-        would blow the webhook timeout (reference: 10s cap,
-        spec_types.go:95).  The build runs on a background thread and
-        requests serve the host engine loop — identical verdicts — until
-        the compiled path is ready."""
-        key = self._policy_key(policies)
+        ``kind`` selects the program: ``validate`` builds a
+        ``BatchScanner``, ``mutate`` a ``MutateScanner`` (a mutate set
+        that does not lower is cached too — callers check ``.ok`` — so
+        the lowering never re-runs per request).  Building pays jax
+        trace + XLA compile (seconds to minutes on a policy-set change);
+        doing that on the request path would blow the webhook timeout
+        (reference: 10s cap, spec_types.go:95).  The build runs on a
+        background thread and requests serve the host engine loop —
+        identical verdicts — until the compiled path is ready.  The
+        circuit breaker is keyed per policy set (kindless): a backend
+        broken for one program kind is broken for the other."""
+        base = self._policy_key(policies)
+        key = (kind,) + base
         with self._scanner_lock:
             scanner = self._scanners.get(key)
             if scanner is not None:
                 self._scanners.move_to_end(key)
                 return scanner
-            if key in self._dead_keys:
+            if base in self._dead_keys:
                 return None  # circuit broken: host loop, no more builds
             if key in self._building:
                 return None  # still compiling; host loop serves meanwhile
@@ -362,12 +377,18 @@ class ResourceHandlers:
 
         def build():
             try:
-                from ..compiler.scan import BatchScanner
-                scanner = BatchScanner(policies, engine=self.engine)
-                # pre-warm the small-batch shape an admission request
-                # hits (AOT-loads from the persistent executable store
-                # when a prior process already compiled this set)
-                scanner.warmup()
+                if kind == 'mutate':
+                    from ..mutate import MutateScanner
+                    scanner = MutateScanner(policies, engine=self.engine)
+                    if scanner.ok:
+                        scanner.warmup()
+                else:
+                    from ..compiler.scan import BatchScanner
+                    scanner = BatchScanner(policies, engine=self.engine)
+                    # pre-warm the small-batch shape an admission request
+                    # hits (AOT-loads from the persistent executable store
+                    # when a prior process already compiled this set)
+                    scanner.warmup()
                 with self._scanner_lock:
                     while len(self._scanners) >= self._scanners_max:
                         self._scanners.popitem(last=False)
@@ -376,8 +397,8 @@ class ResourceHandlers:
                 # a policy set that cannot compile must trip the circuit
                 # breaker, or every request re-spawns a doomed
                 # multi-second compile
-                self._record_key_failure(key, policies,
-                                         f'build failed: {e}')
+                self._record_key_failure(base, policies,
+                                         f'build failed ({kind}): {e}')
             finally:
                 with self._scanner_lock:
                     self._building.discard(key)
@@ -459,19 +480,29 @@ class ResourceHandlers:
         # mirror of the sync path's failure recovery: drop the broken
         # scanner so the next request rebuilds it, and count one breaker
         # failure for the set (the whole batch sheds on one dispatch, so
-        # a broken backend trips the breaker per dispatch, not per rider)
-        key = self._policy_key(policies)
+        # a broken backend trips the breaker per dispatch, not per
+        # rider).  Both program kinds are dropped — the callback only
+        # knows the policy set, and a rebuild of the innocent kind is
+        # cheap next to a broken backend
+        base = self._policy_key(policies)
         with self._scanner_lock:
-            self._scanners.pop(key, None)
+            for kind in ('validate', 'mutate'):
+                self._scanners.pop((kind,) + base, None)
         self._record_key_failure(
-            key, policies,
+            base, policies,
             f'batched scan failed, shedding to host engine: {error}')
 
-    def _batched_scan(self, scanner, policies, request, pctx):
-        """Route one CREATE validate scan through the micro-batcher.
+    def _batched_scan(self, scanner, policies, request, pctx,
+                      old_resource: Optional[dict] = None,
+                      resource: Optional[dict] = None):
+        """Route one validate or mutate scan through the micro-batcher.
 
-        Returns ``(responses, prov)``: this request's per-policy
-        responses (None when the request shed to the host engine loop —
+        The ticket key derives from the scanner identity plus the
+        admission tuple (whose 4th element is the verb), so CREATE and
+        UPDATE requests each coalesce with their own kind — the batch
+        key no longer excludes verbs — and validate/mutate dispatches
+        never mix.  Returns ``(responses, prov)``: this request's result
+        rows (None when the request shed to the host engine loop —
         queue full, deadline blown, dispatch failed, or batcher stopped
         — the caller then serves the identical-verdict host path, never
         a 500) and the decision-provenance fields of whatever happened:
@@ -482,14 +513,15 @@ class ResourceHandlers:
         from ..serving import shed as shed_policy
         from ..serving.queue import QueueFull, Stopped
         batcher = self._get_batcher()
-        resource = admission.request_resource(request)
+        if resource is None:
+            resource = admission.request_resource(request)
         adm = (pctx.admission_info, pctx.exclude_group_roles,
-               pctx.namespace_labels, 'CREATE')
+               pctx.namespace_labels, request.get('operation') or 'CREATE')
         try:
             ticket = batcher.submit(
                 resource=resource, context=pctx.json_context._data,
                 pctx=pctx, admission=adm, scanner=scanner,
-                policies=policies)
+                policies=policies, old_resource=old_resource)
         except QueueFull:
             batcher.record_shed(shed_policy.REASON_QUEUE_FULL)
             return None, {'path':
@@ -548,12 +580,16 @@ class ResourceHandlers:
         pctx.namespace_labels = self.namespace_labels(ns)
 
         responses: List[EngineResponse] = []
-        # device fast path: CREATE requests with no policy exceptions run
-        # through the compiled batch evaluator (exact via host fallback);
-        # UPDATE/DELETE keep the engine loop (old-resource match retry)
+        # device fast path: CREATE and UPDATE requests with no policy
+        # exceptions run through the compiled batch evaluator (exact via
+        # host fallback); UPDATE rows carry oldObject for the scanner's
+        # old-match retry; DELETE keeps the engine loop (no new object)
+        operation = request.get('operation') or ''
         use_device = (self.device and policies and
-                      request.get('operation') == 'CREATE' and
+                      operation in ('CREATE', 'UPDATE') and
                       not pctx.exceptions)
+        old_doc = (admission.request_old_resource(request) or None) \
+            if operation == 'UPDATE' else None
         if use_device:
             try:
                 scanner = self._device_scanner(policies)
@@ -562,11 +598,13 @@ class ResourceHandlers:
                     use_device = False
                 elif self.serving_mode == 'batch':
                     # micro-batching scheduler: this request coalesces
-                    # with concurrent same-policy-set requests into one
-                    # shared device dispatch (serving/batcher.py); a
-                    # shed comes back as None and the host loop serves
+                    # with concurrent same-policy-set same-verb requests
+                    # into one shared device dispatch
+                    # (serving/batcher.py); a shed comes back as None
+                    # and the host loop serves
                     batched, bprov = self._batched_scan(
-                        scanner, policies, request, pctx)
+                        scanner, policies, request, pctx,
+                        old_resource=old_doc)
                     prov_path = bprov.pop('path')
                     prov_extra = bprov
                     prov_extra['fingerprint'] = getattr(
@@ -585,8 +623,9 @@ class ResourceHandlers:
                             contexts=[pctx.json_context._data],
                             admission=(pctx.admission_info,
                                        pctx.exclude_group_roles,
-                                       pctx.namespace_labels, 'CREATE'),
-                            pctx_factory=lambda doc: pctx)
+                                       pctx.namespace_labels, operation),
+                            pctx_factory=lambda doc: pctx,
+                            old_resources=[old_doc] if old_doc else None)
                     prov_path = 'sync'
                     if cap is not None:
                         device_eval_s = cap.stage_s('device_eval')
@@ -610,11 +649,11 @@ class ResourceHandlers:
                 # Repeated failures trip the per-set circuit breaker —
                 # otherwise every request would pay a full policy-set
                 # recompile before falling back.
-                key = self._policy_key(policies)
+                base = self._policy_key(policies)
                 with self._scanner_lock:
-                    self._scanners.pop(key, None)
+                    self._scanners.pop(('validate',) + base, None)
                 self._record_key_failure(
-                    key, policies,
+                    base, policies,
                     f'scan failed, falling back to host engine: {e}')
                 provenance.notify_scan_error(e)
                 use_device = False
@@ -745,6 +784,93 @@ class ResourceHandlers:
         except Exception:  # noqa: BLE001 - context stays unpatched
             pass
 
+    def _post_mutate_policy(self, uid: str, policy, er: EngineResponse,
+                            patches: List[dict],
+                            responses: List[EngineResponse],
+                            failure_policy: str) -> Optional[dict]:
+        """Per-policy admission bookkeeping shared by the host mutate
+        loop and the device fast path: deny on failure, collect patches,
+        schema-validate the patched resource.  Returns the deny response
+        or None to continue the chain."""
+        if not er.is_successful():
+            # a failed/errored mutate rule fails the admission —
+            # failurePolicy only covers webhook transport failures
+            # (reference: mutation.go:163 applyMutation →
+            # mutation.go:112 'mutation policy %s error')
+            failed = er.get_failed_rules()
+            return admission.response(
+                uid, False,
+                f'mutation policy {policy.name} error: failed to '
+                f'apply policy {policy.name} rules {failed}')
+        policy_patches = [p for rr in er.policy_response.rules
+                          for p in (rr.patches or [])]
+        if policy_patches:
+            patches.extend(policy_patches)
+            # the mutated resource must stay schema-valid
+            # (reference: mutation.go → openapi.ValidateResource,
+            # pkg/openapi/manager.go:88)
+            if self.openapi_manager is not None and er.patched_resource:
+                from ..openapi.manager import ValidationError
+                try:
+                    self.openapi_manager.validate_resource(
+                        er.patched_resource)
+                except ValidationError as e:
+                    return admission.response(
+                        uid, False,
+                        f'mutated resource failed schema validation: '
+                        f'{e}')
+        responses.append(er)
+        if er.is_error() and failure_policy == 'Fail':
+            return admission.response(
+                uid, False, get_blocked_messages(responses))
+        return None
+
+    def _device_mutate_steps(self, request: dict, pctx,
+                             mutate_policies) -> Optional[list]:
+        """The device mutate chain for one request, or None when the
+        host engine loop must serve it (knob off, verb outside
+        CREATE/UPDATE, exceptions/subresource in play, set not lowered,
+        scanner still building, shed, or scan failure — never a 500).
+        Returns the ordered ``[(policy, EngineResponse), ...]`` steps,
+        bit-identical to the host loop by construction
+        (kyverno_tpu/mutate/scanner.py)."""
+        operation = request.get('operation') or ''
+        if not (self.device and self.mutate_device and mutate_policies and
+                operation in ('CREATE', 'UPDATE') and
+                not pctx.exceptions and not request.get('subResource')):
+            return None
+        try:
+            scanner = self._device_scanner(mutate_policies, kind='mutate')
+            if scanner is None or not scanner.ok:
+                # still lowering, or the set does not lower (the
+                # placement records on the coverage ledger name why)
+                return None
+            if self.serving_mode == 'batch':
+                row, _prov = self._batched_scan(
+                    scanner, mutate_policies, request, pctx,
+                    resource=pctx.new_resource)
+                return row  # None on shed -> host loop
+            [row] = scanner.scan(
+                [pctx.new_resource],
+                admission=(pctx.admission_info,
+                           pctx.exclude_group_roles,
+                           pctx.namespace_labels, operation),
+                pctx_factory=lambda doc: pctx)
+            with self._scanner_lock:
+                self._key_failures.pop(self._policy_key(mutate_policies),
+                                       None)
+            return row
+        except Exception as e:  # noqa: BLE001
+            # identical never-500 recovery to the validate path: drop
+            # the broken scanner, count one breaker failure, host loop
+            base = self._policy_key(mutate_policies)
+            with self._scanner_lock:
+                self._scanners.pop(('mutate',) + base, None)
+            self._record_key_failure(
+                base, mutate_policies,
+                f'mutate scan failed, falling back to host engine: {e}')
+            return None
+
     def mutate(self, request: dict, failure_policy: str = 'Fail') -> dict:
         """reference: pkg/webhooks/resource/handlers.go:157 Mutate +
         mutation.go:80 applyMutations (sequential, cumulative)."""
@@ -769,48 +895,43 @@ class ResourceHandlers:
 
         patches: List[dict] = []
         responses: List[EngineResponse] = []
-        for policy in mutate_policies:
-            if not any(r.has_mutate() for r in policy.rules):
-                continue
-            ctx = pctx.copy()
-            ctx.policy = policy
-            er = self.engine.mutate(ctx)
-            if not er.is_successful():
-                # a failed/errored mutate rule fails the admission —
-                # failurePolicy only covers webhook transport failures
-                # (reference: mutation.go:163 applyMutation →
-                # mutation.go:112 'mutation policy %s error')
-                failed = er.get_failed_rules()
-                return admission.response(
-                    uid, False,
-                    f'mutation policy {policy.name} error: failed to '
-                    f'apply policy {policy.name} rules {failed}')
-            policy_patches = [p for rr in er.policy_response.rules
-                              for p in (rr.patches or [])]
-            if policy_patches:
-                patches.extend(policy_patches)
-                # the mutated resource must stay schema-valid
-                # (reference: mutation.go → openapi.ValidateResource,
-                # pkg/openapi/manager.go:88)
-                if self.openapi_manager is not None and er.patched_resource:
-                    from ..openapi.manager import ValidationError
-                    try:
-                        self.openapi_manager.validate_resource(
-                            er.patched_resource)
-                    except ValidationError as e:
-                        return admission.response(
-                            uid, False,
-                            f'mutated resource failed schema validation: '
-                            f'{e}')
-            # mutations apply cumulatively: the patched resource re-enters
-            # the context for the next policy (mutation.go:123)
-            pctx = pctx.copy()
-            pctx.new_resource = er.patched_resource or pctx.new_resource
-            pctx.json_context.add_resource(pctx.new_resource)
-            responses.append(er)
-            if er.is_error() and failure_policy == 'Fail':
-                return admission.response(
-                    uid, False, get_blocked_messages(responses))
+        # device fast path: a lowered mutate policy set evaluates its
+        # whole cumulative chain as one batched device dispatch
+        # (kyverno_tpu/mutate/) whose rows coalesce with concurrent
+        # mutate requests in batch serving mode
+        device_row = self._device_mutate_steps(request, pctx,
+                                               mutate_policies)
+        if device_row is not None:
+            steps, patched = device_row
+            for policy, er in steps:
+                deny = self._post_mutate_policy(uid, policy, er, patches,
+                                                responses, failure_policy)
+                if deny is not None:
+                    return deny
+            if steps:
+                # verify-images policies see the chain's cumulative
+                # output, exactly as the host loop threads it
+                pctx = pctx.copy()
+                pctx.new_resource = patched or pctx.new_resource
+                pctx.json_context.add_resource(pctx.new_resource)
+        else:
+            for policy in mutate_policies:
+                if not any(r.has_mutate() for r in policy.rules):
+                    continue
+                ctx = pctx.copy()
+                ctx.policy = policy
+                er = self.engine.mutate(ctx)
+                deny = self._post_mutate_policy(uid, policy, er, patches,
+                                                responses, failure_policy)
+                if deny is not None:
+                    return deny
+                # mutations apply cumulatively: the patched resource
+                # re-enters the context for the next policy
+                # (mutation.go:123)
+                pctx = pctx.copy()
+                pctx.new_resource = er.patched_resource or \
+                    pctx.new_resource
+                pctx.json_context.add_resource(pctx.new_resource)
         for policy in verify_policies:
             ctx = pctx.copy()
             ctx.policy = policy

@@ -223,6 +223,29 @@ def test_ktpu302_scoped_to_compiler(tmp_path):
     assert not rep.active
 
 
+def test_ktpu302_covers_device_mutate_package(tmp_path):
+    """The device-side mutate package shares the FALLBACK discipline;
+    engine/mutate/ (the host oracle) stays out of scope."""
+    pos = tmp_path / 'pos'
+    pos.mkdir()
+    rep = run(pos, {'mutate/m.py': """\
+    FALLBACK = object()
+
+    def bad(doc):
+        return FALLBACK
+    """}, rules=['KTPU302'])
+    assert rule_ids(rep) == {'KTPU302'}
+    neg = tmp_path / 'neg'
+    neg.mkdir()
+    rep = run(neg, {'engine/mutate/m.py': """\
+    FALLBACK = object()
+
+    def bad(doc):
+        return FALLBACK
+    """}, rules=['KTPU302'])
+    assert not rep.active
+
+
 def test_ktpu303_positive_negative(tmp_path):
     rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU303'])
     # no reference site anywhere → every taxonomy reason is dead

@@ -155,7 +155,9 @@ def test_device_crash_falls_back_to_host_engine():
     policies = handlers.cache.get_policies(
         VALIDATE_ENFORCE, 'Pod', 'default')
     assert policies
-    key = handlers._policy_key(policies)
+    # scanner cache keys are (kind,) + policy ids since the mutate
+    # scanner landed; the validate path serves from the 'validate' slot
+    key = ('validate',) + handlers._policy_key(policies)
     handlers._scanners[key] = Bomb()
 
     server = WebhookServer(handlers)

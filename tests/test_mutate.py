@@ -228,3 +228,106 @@ class TestEngineMutate:
             'metadata': {'name': 'p', 'namespace': 'default'},
             'spec': {'containers': [{'name': 'c', 'image': 'x'}]}})
         assert resp.patched_resource['metadata']['labels']['team'] == 'default-team'
+
+
+class TestNoDeepcopyApplier:
+    """PR-8 satellite: the host strategic-merge applier dropped its
+    per-(resource, element) deepcopies (the '10-20x more host work'
+    note).  Pins the two properties that made that safe: preprocessing
+    never mutates the rule-constant overlay, and the output is
+    identical to a deepcopy-based reference applier."""
+
+    OVERLAY = {
+        'metadata': {'labels': {'+(team)': 'default', 'stage': 'prod'},
+                     'annotations': {'owner': 'core'}},
+        'spec': {
+            'dnsPolicy': 'ClusterFirst',
+            'containers': [{
+                '(name)': '*',
+                'securityContext': {'+(runAsNonRoot)': True},
+            }],
+        },
+    }
+
+    def _docs(self):
+        return [
+            {'apiVersion': 'v1', 'kind': 'Pod',
+             'metadata': {'name': 'a'},
+             'spec': {'containers': [{'name': 'c1', 'image': 'nginx'}]}},
+            {'apiVersion': 'v1', 'kind': 'Pod',
+             'metadata': {'name': 'b', 'labels': {'team': 'blue'}},
+             'spec': {'containers': [
+                 {'name': 'c1', 'image': 'nginx',
+                  'securityContext': {'runAsNonRoot': False}},
+                 {'name': 'c2', 'image': 'redis'}]}},
+            {'apiVersion': 'v1', 'kind': 'Pod',
+             'metadata': {'name': 'c', 'labels': {'stage': 'dev'}},
+             'spec': {'containers': [], 'dnsPolicy': 'Default'}},
+        ]
+
+    def test_overlay_never_mutated_across_resources(self):
+        import copy
+        import json
+        overlay = copy.deepcopy(self.OVERLAY)
+        pin = json.dumps(overlay, sort_keys=True)
+        for doc in self._docs():
+            apply_strategic_merge_patch(copy.deepcopy(doc), overlay)
+            assert json.dumps(overlay, sort_keys=True) == pin
+
+    def test_base_never_mutated(self):
+        import copy
+        import json
+        for doc in self._docs():
+            base = copy.deepcopy(doc)
+            pin = json.dumps(base, sort_keys=True)
+            apply_strategic_merge_patch(base, self.OVERLAY)
+            assert json.dumps(base, sort_keys=True) == pin
+
+    def test_output_identical_to_deepcopy_reference(self):
+        """The reference applier deepcopies overlay and base per call —
+        exactly what the applier did before the copy-on-write change."""
+        import copy
+        from kyverno_tpu.engine.mutate.strategic import (
+            ConditionError, GlobalConditionError, preprocess_pattern)
+
+        def reference(base, overlay):
+            overlay = copy.deepcopy(overlay)
+            try:
+                overlay = preprocess_pattern(overlay,
+                                             copy.deepcopy(base))
+            except (ConditionError, GlobalConditionError):
+                return copy.deepcopy(base)
+            return strategic_merge(copy.deepcopy(base), overlay)
+
+        for doc in self._docs():
+            got = apply_strategic_merge_patch(copy.deepcopy(doc),
+                                              self.OVERLAY)
+            want = reference(doc, self.OVERLAY)
+            assert got == want
+
+    def test_engine_rule_output_identical_to_reference(self):
+        """Whole-rule check through the engine loop: same responses and
+        patched doc as a deepcopy of the same policy applied the old
+        way (fresh Policy objects per run, so no state can leak)."""
+        import copy
+        import json
+        policy_doc = {
+            'apiVersion': 'kyverno.io/v1', 'kind': 'ClusterPolicy',
+            'metadata': {'name': 'p'},
+            'spec': {'rules': [{
+                'name': 'r',
+                'match': {'any': [{'resources': {'kinds': ['Pod']}}]},
+                'mutate': {'patchStrategicMerge': self.OVERLAY}}]}}
+        engine = Engine()
+        for doc in self._docs():
+            outs = []
+            for policy in (Policy(copy.deepcopy(policy_doc)),
+                           Policy(copy.deepcopy(policy_doc))):
+                pctx = PolicyContext(
+                    policy, new_resource=copy.deepcopy(doc))
+                er = engine.mutate(pctx)
+                outs.append((
+                    [(r.name, str(r.status), r.message, r.patches)
+                     for r in er.policy_response.rules],
+                    json.dumps(er.patched_resource, sort_keys=True)))
+            assert outs[0] == outs[1]

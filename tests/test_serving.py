@@ -380,3 +380,302 @@ class TestBatcherUnit:
                 batcher.stop(drain=False)
         finally:
             set_global_registry(None)
+
+
+# ---------------------------------------------------------------------------
+# full-verb batching: UPDATE validate rows and mutate requests ride the
+# same queue/coalescing loop (PR 8) — the batch key no longer excludes
+# verbs, and the host engine loop stays the bit-identity oracle.
+
+MUTATE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: add-team-label
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: add-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchStrategicMerge:
+          metadata:
+            labels:
+              "+(team)": platform
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: stamp-managed
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  rules:
+    - name: stamp
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      mutate:
+        patchesJson6902: |-
+          - op: add
+            path: /metadata/annotations/managed
+            value: kyverno-tpu
+"""
+
+# the selector only matches the OLD object of some UPDATE requests —
+# the engine's old-match retry must survive batching
+LEGACY_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: legacy-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: legacy-team
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+              selector: {matchLabels: {legacy: "yes"}}
+      validate:
+        message: "legacy pods must be marked migrated"
+        pattern:
+          metadata:
+            labels:
+              migrated: "?*"
+"""
+
+
+def update_review_bytes(resource, old_resource, uid):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': uid, 'operation': 'UPDATE',
+            'kind': {'group': '', 'version': 'v1', 'kind': 'Pod'},
+            'namespace': 'default',
+            'name': resource['metadata']['name'],
+            'object': resource, 'oldObject': old_resource,
+            'userInfo': {'username': 'alice', 'groups': []},
+        }}).encode()
+
+
+@pytest.fixture(scope='module')
+def verb_chain():
+    """Validate (incl. a selector rule exercising the old-match retry)
+    + mutate policies on one compiled chain in batch serving mode."""
+    docs = list(yaml.safe_load_all(ENFORCE_POLICY)) + \
+        list(yaml.safe_load_all(LEGACY_POLICY)) + \
+        list(yaml.safe_load_all(MUTATE_POLICY))
+    cache = Cache()
+    cache.warm_up([Policy(d) for d in docs if d])
+    handlers = ResourceHandlers(cache, configuration=Configuration(),
+                                serving_mode='batch')
+    server = WebhookServer(handlers, configuration=Configuration())
+    enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod', 'default')
+    assert handlers.wait_device_ready(enforce, timeout=600)
+    mut = cache.get_policies(pcache.MUTATE, 'Pod', 'default')
+    deadline = time.time() + 120
+    scanner = None
+    while time.time() < deadline:
+        scanner = handlers._device_scanner(mut, kind='mutate')
+        if scanner is not None:
+            break
+        time.sleep(0.02)
+    assert scanner is not None and scanner.ok
+    yield server, handlers
+    handlers.shutdown()
+
+
+def mixed_verb_requests(n):
+    """CREATE/UPDATE mixed validate traffic; some UPDATE rows match the
+    legacy selector only through their old object."""
+    out = []
+    for i in range(n):
+        labels = {'team': 'infra'} if i % 2 else {}
+        new = pod(dict(labels), f'p{i}')
+        if i % 3 == 0:
+            old = pod({'legacy': 'yes', **labels}, f'p{i}')
+            out.append((f'u{i}', 'UPDATE', new, old))
+        elif i % 3 == 1:
+            out.append((f'u{i}', 'UPDATE', new, pod(dict(labels), f'p{i}')))
+        else:
+            out.append((f'u{i}', 'CREATE', new, None))
+    return out
+
+
+def _verb_bytes(entry):
+    uid, op, new, old = entry
+    if op == 'UPDATE':
+        return update_review_bytes(new, old, uid)
+    return review_bytes(new, uid)
+
+
+class TestFullVerbBatching:
+    def test_mixed_verb_batched_bit_identity(self, verb_chain):
+        """16 threads of UPDATE+CREATE validate traffic: batched
+        responses byte-identical to sync, coalescing observed."""
+        server, handlers = verb_chain
+        handlers._get_batcher().reset_stats()
+        requests = mixed_verb_requests(16 * 8)
+        results = {}
+        errors = []
+        barrier = threading.Barrier(16)
+
+        def work(tid):
+            barrier.wait()
+            for entry in requests[tid * 8:(tid + 1) * 8]:
+                try:
+                    out, status = server.handle_request(
+                        '/validate/fail', _verb_bytes(entry))
+                    assert status == 200
+                    results[entry[0]] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+        stats = handlers._get_batcher().stats()
+        assert stats['occupancy_mean'] > 1.0, stats
+        prior = handlers.serving_mode
+        handlers.serving_mode = 'sync'
+        try:
+            expected = {e[0]: server.handle('/validate/fail',
+                                            _verb_bytes(e))
+                        for e in requests}
+        finally:
+            handlers.serving_mode = prior
+        for entry in requests:
+            assert results[entry[0]] == expected[entry[0]]
+
+    def test_update_old_match_retry_identical_to_host(self, verb_chain):
+        """An UPDATE whose old object alone matches the legacy selector
+        must deny exactly like the pure host engine loop."""
+        server, handlers = verb_chain
+        # new passes require-team but is not 'migrated'; only the OLD
+        # object carries the legacy selector label, so the rule applies
+        # to this UPDATE solely through the old-match retry
+        new = pod({'team': 'infra'}, 'retry-pod')
+        old = pod({'legacy': 'yes', 'team': 'infra'}, 'retry-pod')
+        body = update_review_bytes(new, old, 'u-retry')
+        batched = server.handle('/validate/fail', body)
+        prior_mode, prior_device = handlers.serving_mode, handlers.device
+        handlers.serving_mode = 'sync'
+        try:
+            synced = server.handle('/validate/fail', body)
+            handlers.device = False
+            host = server.handle('/validate/fail', body)
+        finally:
+            handlers.serving_mode, handlers.device = \
+                prior_mode, prior_device
+        assert batched == synced == host
+        assert json.loads(batched)['response']['allowed'] is False
+        # the same new object on CREATE passes (selector never matches)
+        create = json.loads(server.handle(
+            '/validate/fail', review_bytes(new, 'u-retry-create')))
+        assert create['response']['allowed'] is True
+
+    def test_batched_mutate_byte_identical_to_host_engine(self,
+                                                          verb_chain):
+        """Mutate responses through the batched device path are
+        byte-identical to the host engine loop, and concurrent mutate
+        requests coalesce (occupancy > 1)."""
+        server, handlers = verb_chain
+        handlers._get_batcher().reset_stats()
+        requests = []
+        for i in range(48):
+            labels = {'team': 'x'} if i % 2 else {}
+            new = pod(dict(labels), f'm{i}')
+            if i % 3 == 0:
+                requests.append((f'mu{i}', 'UPDATE', new,
+                                 pod(dict(labels), f'm{i}')))
+            else:
+                requests.append((f'mu{i}', 'CREATE', new, None))
+        results = {}
+        errors = []
+        barrier = threading.Barrier(12)
+
+        def work(tid):
+            barrier.wait()
+            for entry in requests[tid * 4:(tid + 1) * 4]:
+                try:
+                    out, status = server.handle_request(
+                        '/mutate', _verb_bytes(entry))
+                    assert status == 200
+                    results[entry[0]] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+        stats = handlers._get_batcher().stats()
+        assert stats['occupancy_mean'] > 1.0, stats
+        # oracle: the pure host engine loop (device mutate off)
+        prior = handlers.mutate_device
+        handlers.mutate_device = False
+        try:
+            expected = {e[0]: server.handle('/mutate', _verb_bytes(e))
+                        for e in requests}
+        finally:
+            handlers.mutate_device = prior
+        for entry in requests:
+            assert results[entry[0]] == expected[entry[0]]
+        # and patches actually flowed
+        sample = json.loads(results['mu1'])
+        assert sample['response'].get('patch')
+
+    def test_shed_to_host_never_500_on_new_verb_paths(
+            self, restore_batcher, verb_chain):
+        """Overflowing a tiny queue with mixed UPDATE validate + mutate
+        traffic sheds to the host loop: all 200s, identical bytes."""
+        server, handlers = verb_chain
+        handlers._batcher = AdmissionBatcher(
+            window_ms=50, queue_cap=2,
+            on_success=handlers._batch_scan_ok,
+            on_failure=handlers._batch_scan_failed)
+        requests = mixed_verb_requests(24)
+        statuses = []
+        results = {}
+        errors = []
+        barrier = threading.Barrier(12)
+
+        def work(tid):
+            barrier.wait()
+            for entry in requests[tid * 2:(tid + 1) * 2]:
+                route = '/mutate' if int(entry[0][1:]) % 2 else \
+                    '/validate/fail'
+                try:
+                    out, status = server.handle_request(
+                        route, _verb_bytes(entry))
+                    statuses.append(status)
+                    results[(route, entry[0])] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+        assert statuses == [200] * len(requests)
+        prior_mode = handlers.serving_mode
+        prior_mut = handlers.mutate_device
+        handlers.serving_mode = 'sync'
+        handlers.mutate_device = False
+        try:
+            for (route, uid), got in results.items():
+                entry = next(e for e in requests if e[0] == uid)
+                assert got == server.handle(route, _verb_bytes(entry))
+        finally:
+            handlers.serving_mode = prior_mode
+            handlers.mutate_device = prior_mut
