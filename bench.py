@@ -147,6 +147,14 @@ spec:
 #: (~10% of generated pods deliberately trip per-row FALLBACK)
 MUTATE_DEVICE_RATIO_FLOOR = 0.75
 
+#: warm-up ratchet (mirrors MUTATE_DEVICE_RATIO_FLOOR): a fresh process
+#: sweeping row counts from 1 past the chunk may compile/load at most
+#: this many evaluator executables for the policy set — the canonical
+#: shape table (compiler/shapes.py) guarantees 2; the power-of-two
+#: bucket ladder this replaced minted up to 9 (BENCH r03-r05 measured
+#: that zoo at warm_s 49-93s / cache_warm_s 92.7s against ~28s of scan)
+WARM_EXECUTABLES_MAX = 2
+
 _IMAGES = ['nginx:1.25.3', 'nginx:latest', 'ghcr.io/org/app:v2.1',
            'redis:7', 'docker.io/library/busybox', 'gcr.io/proj/svc:prod',
            'app', 'registry.internal:5000/team/api:canary']
@@ -647,6 +655,63 @@ def cache_probe(platform: str) -> float:
     return -1.0
 
 
+def warm_probe(platform: str) -> dict:
+    """Fresh-process warm block: time-to-first-decision plus the
+    executable census, in a new interpreter (cold jit caches, whatever
+    is on disk from this run).  The subprocess scans ONE pod (ttfd —
+    what a restarting webhook pod pays before its first verdict), then
+    sweeps the boundary row counts {1, small+1, chunk+1} so every
+    canonical shape (and the multi-chunk spill) is exercised, and
+    reports how many executables that took.  THE RATCHET: more than
+    ``WARM_EXECUTABLES_MAX`` compiles+loads per policy set fails the
+    bench — the bucket zoo must not regrow."""
+    code = (
+        'import json, random, sys, time\n'
+        't0 = time.time()\n'
+        'sys.path.insert(0, %r)\n'
+        'import bench\n'
+        'from kyverno_tpu.observability import device as devtel\n'
+        'from kyverno_tpu.observability.metrics import MetricsRegistry\n'
+        'reg = devtel.configure(MetricsRegistry())\n'
+        'from kyverno_tpu.compiler.scan import BatchScanner\n'
+        'scanner = BatchScanner(bench.load_policy_pack())\n'
+        'rng = random.Random(0)\n'
+        'scanner.scan([bench.make_pod(rng, 0)])\n'
+        'ttfd = time.time() - t0\n'
+        'for n in (scanner.SMALL_BATCH + 1, scanner.CHUNK + 1):\n'
+        '    scanner.scan_statuses('
+        '[bench.make_pod(rng, i) for i in range(n)])\n'
+        'C = "kyverno_tpu_compile_cache_requests_total"\n'
+        'print("WARMPROBE " + json.dumps({\n'
+        '    "ttfd_s": round(ttfd, 2),\n'
+        '    "sweep_s": round(time.time() - t0, 2),\n'
+        '    "executables_compiled": int(reg.counter_value('
+        'C, result="miss")),\n'
+        '    "executables_loaded": int(reg.counter_value('
+        'C, result="aot_load")),\n'
+        '}))\n'
+    ) % os.path.dirname(os.path.abspath(__file__))
+    probe: dict = {'error': 'probe produced no WARMPROBE line'}
+    try:
+        out = subprocess.run([sys.executable, '-c', code],
+                             capture_output=True, text=True, timeout=900)
+        for line in out.stdout.splitlines():
+            if line.startswith('WARMPROBE'):
+                probe = json.loads(line[len('WARMPROBE '):])
+    except Exception as e:  # noqa: BLE001 - report, ratchet below
+        probe = {'error': f'{type(e).__name__}: {e}'}
+    probe['row_counts_swept'] = '1, small+1, chunk+1'
+    probe['ratchet_max_executables'] = WARM_EXECUTABLES_MAX
+    executables = probe.get('executables_compiled', 0) + \
+        probe.get('executables_loaded', 0)
+    if 'error' not in probe and executables > WARM_EXECUTABLES_MAX:
+        raise AssertionError(
+            f'fresh-process warm-up used {executables} executables '
+            f'(> committed max {WARM_EXECUTABLES_MAX}) — the canonical '
+            f'batch-shape table is not holding')
+    return probe
+
+
 def _peak_rss_mb() -> float:
     import resource as _resource
     return _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
@@ -842,6 +907,13 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     _progress('fresh-process cache probe')
     cache_warm_s = cache_probe(platform) \
         if os.environ.get('BENCH_CACHE_PROBE', '1') == '1' else -1.0
+
+    # fresh-process warm block: time-to-first-decision + the executable
+    # census across the boundary row counts, ratcheted at
+    # WARM_EXECUTABLES_MAX (a regrown bucket zoo fails the bench)
+    _progress('fresh-process warm probe')
+    warm_block = warm_probe(platform) \
+        if os.environ.get('BENCH_WARM_PROBE', '1') == '1' else None
     _progress('done')
 
     result = {
@@ -869,6 +941,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'peak_rss_mb': round(peak_rss_mb, 1),
         'rss_before_scan_mb': round(rss_before_mb, 1),
         'cache_warm_s': round(cache_warm_s, 2),
+        'warm': warm_block,
         'sieve_n': sieve_n,
         'sieve_decisions_per_sec': round(sieve_rate, 1),
         'host_engine_decisions_per_sec': round(host_rate, 1),
@@ -1456,6 +1529,21 @@ def main() -> int:
             print(json.dumps({
                 'metric': 'admission_concurrency', 'platform': platform,
                 'error': f'{type(e).__name__}: {e}'}))
+            return 1
+    if '--warm-probe' in sys.argv[1:]:
+        # standalone warm block: fresh-process time-to-first-decision +
+        # executable census with the WARM_EXECUTABLES_MAX ratchet
+        try:
+            print(json.dumps(dict(warm_probe(platform),
+                                  metric='warm_probe',
+                                  platform=platform)))
+            return 0
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({'metric': 'warm_probe',
+                              'platform': platform,
+                              'error': f'{type(e).__name__}: {e}'}))
             return 1
     if '--mutate-pack' in sys.argv[1:]:
         try:

@@ -19,6 +19,12 @@ shapes defeat that cache silently:
   every distinct shape takes a different branch → one executable per
   shape.  Intentional shape-bucketing gets a ``# ktpu: noqa[KTPU203]``
   with the reason; accidental shape branching gets rewritten.
+* **KTPU204** — a batch-encode entry (``encode_batch`` /
+  ``encode_mutate_batch``) whose ``padded_n`` is computed instead of
+  drawn from the canonical shape table (``compiler/shapes.py``): magic
+  row counts and ``1 << n.bit_length()`` ladders each mint a fresh XLA
+  shape, silently regrowing the per-bucket executable zoo the ragged
+  kernels retired.
 """
 
 from __future__ import annotations
@@ -209,3 +215,96 @@ def _check_shape_branch(ctx: Context) -> Iterable[Finding]:
                         f'`{fn.name}` retraces per distinct shape — '
                         f'bucket shapes deliberately (and noqa with '
                         f'the reason) or make the code rank-generic')
+
+
+#: batch-encode entry points whose row padding decides a compiled shape
+_ENCODE_ENTRIES = frozenset({'encode_batch', 'encode_mutate_batch'})
+#: provenance that marks a padded_n as canonical-table-derived
+_CANONICAL_FNS = frozenset({'canonical_capacity', 'canonical_caps',
+                            'small_capacity', 'pad_to_multiple'})
+
+
+def _callee_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _padded_n_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == 'padded_n':
+            return kw.value
+    # encode_batch(resources, cps, padded_n, ...) /
+    # encode_mutate_batch(resources, program, padded_n, ...)
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _contains_canonical_call(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and
+               _callee_name(n.func) in _CANONICAL_FNS
+               for n in ast.walk(expr))
+
+
+def _looks_computed(expr: ast.AST) -> bool:
+    """True for the bucket-ladder shapes: bit_length()/shift
+    arithmetic, or a hard-coded nonzero row count."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _callee_name(n.func) == \
+                'bit_length':
+            return True
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.LShift):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) and \
+                not isinstance(n.value, bool) and n.value != 0:
+            return True
+    return False
+
+
+@register('KTPU204', 'batch-encode padded_n not drawn from the '
+                     'canonical shape table (compiler/shapes.py) — '
+                     'each computed row count mints a fresh XLA '
+                     'executable (the bucket zoo regrows)')
+def _check_canonical_padding(ctx: Context) -> Iterable[Finding]:
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        # innermost enclosing scope per call site, for one-level
+        # name resolution of `padded_n=<name>` (same local-dataflow
+        # depth as the KTPU1xx taint passes)
+        scopes: List[Tuple[ast.AST, ast.Call]] = []
+
+        def visit(node: ast.AST, scope: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = child
+                if isinstance(child, ast.Call) and \
+                        _callee_name(child.func) in _ENCODE_ENTRIES:
+                    scopes.append((scope, child))
+                visit(child, inner)
+
+        visit(sf.tree, sf.tree)
+        for scope, call in scopes:
+            expr = _padded_n_arg(call)
+            if expr is None:
+                continue
+            if isinstance(expr, ast.Name):
+                resolved = _scope_bindings(scope).get(expr.id)
+                if resolved is None:
+                    continue  # parameter / out-of-scope: undecidable
+                expr = resolved
+            if _contains_canonical_call(expr):
+                continue
+            if _looks_computed(expr):
+                entry = _callee_name(call.func)
+                yield sf.finding(
+                    'KTPU204', call,
+                    f'`{entry}` padded_n is computed locally — draw '
+                    f'it from the canonical shape table '
+                    f'(compiler/shapes.canonical_capacity) so XLA '
+                    f'only ever compiles the canonical row shapes')

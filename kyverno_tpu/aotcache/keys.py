@@ -19,7 +19,10 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 
 #: bump to invalidate every persisted executable (framing/codec changes)
-AOT_VERSION = 2
+#: v3: blobs carry compile-time meta (host features / env scope / jax
+#: versions) re-checked at load; batch layouts moved to the canonical
+#: capacity table (compiler/shapes.py), retiring the pow-2 bucket zoo
+AOT_VERSION = 3
 
 _SOURCE_DIGEST: Optional[str] = None
 

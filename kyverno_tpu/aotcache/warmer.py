@@ -42,9 +42,13 @@ def _env_enabled() -> bool:
 class Warmer:
     """Runs ``warm_fn`` once in the background and reports readiness.
 
-    ``warm_fn`` returns a short human-readable detail string (or None);
-    an exception marks the warmer ``failed`` — serving is unaffected
-    either way, the un-warmed paths lazily compile as before.
+    ``warm_fn`` returns a short human-readable detail string (or None),
+    or a ``(detail, attrs)`` pair — ``attrs`` land on the
+    ``kyverno/aot/warmer`` span, so a warm pass that loads the canonical
+    batch shapes can report exactly how many executables it brought up
+    (and from where).  An exception marks the warmer ``failed`` —
+    serving is unaffected either way, the un-warmed paths lazily
+    compile as before.
     """
 
     def __init__(self, warm_fn: Callable[[], Optional[str]],
@@ -95,7 +99,12 @@ class Warmer:
         with tracing.start_span('kyverno/aot/warmer',
                                 {'target': self.name}) as span:
             try:
-                self.detail = self.warm_fn()
+                detail = self.warm_fn()
+                if isinstance(detail, tuple):
+                    detail, attrs = detail
+                    for k, v in (attrs or {}).items():
+                        span.set_attribute(k, v)
+                self.detail = detail
                 self.state = READY
             except Exception as e:  # noqa: BLE001 - warm failure must
                 # never take serving down; the lazy path still compiles

@@ -86,8 +86,11 @@ class AdmissionController:
 
     def _warm_admission(self):
         """Warm-fn for the AOT warmer: build (or AOT-load) the compiled
-        scanner for the installed enforce policy set so the first real
-        admission request hits a serving executable."""
+        scanner for the installed enforce policy set — then bring EVERY
+        canonical batch capacity to readiness on a small thread pool
+        (the audit path scans at the bulk capacity, admission at the
+        small one; a warm AOT store loads them in ~max, not sum).  The
+        span reports how many shapes came up."""
         from ..policycache import cache as pcache
         self.sync_policies()
         enforce = self.cache.get_policies(pcache.VALIDATE_ENFORCE,
@@ -97,8 +100,19 @@ class AdmissionController:
         if not self.handlers.device:
             return 'device path disabled'
         ok = self.handlers.wait_device_ready(enforce, timeout=600.0)
-        return ('compiled scanner serving' if ok
-                else 'device path unavailable; host loop serves')
+        if not ok:
+            return 'device path unavailable; host loop serves'
+        scanner = self.handlers._device_scanner(enforce)
+        shapes = {}
+        if scanner is not None and hasattr(scanner, 'warmup_shapes'):
+            shapes = scanner.warmup_shapes()
+        detail = 'compiled scanner serving' + (
+            ' (capacities ' +
+            ', '.join(f'{c}:{s:.1f}s' for c, s in sorted(shapes.items()))
+            + ')' if shapes else '')
+        return detail, {'shapes_warmed': len(shapes),
+                        'shape_caps': ','.join(str(c)
+                                               for c in sorted(shapes))}
 
     def _create_ur(self, ur_spec: dict) -> None:
         from ..background.updaterequest import UpdateRequestGenerator

@@ -11,10 +11,19 @@ compile: a second process reaches device-served scans with zero fresh
 XLA compiles for a cached policy set.
 
 Blobs are ``codec byte + compressed pickle((payload, in_tree,
-out_tree))``; zstandard when available, stdlib zlib otherwise (the
-seed's hard zstandard dependency silently disabled the disk path on
-hosts without it).  Integrity framing and eviction live one layer down
-in :class:`kyverno_tpu.aotcache.store.AotStore` — a corrupt or
+out_tree, meta))``; zstandard when available, stdlib zlib otherwise
+(the seed's hard zstandard dependency silently disabled the disk path
+on hosts without it).  ``meta`` records the compile-time environment
+(host CPU-feature fingerprint, codegen env scope, jax versions):
+XLA:CPU AOT artifacts embed the compile machine's instruction-set
+features and can SIGILL when loaded on a host missing them — the cache
+*key* already scopes on these axes, but containerized fleets can mask
+``/proc/cpuinfo`` into a collision, so the load path re-checks the
+recorded meta and REJECTS mismatched entries (fresh compile via the
+persistent XLA cache instead of a possibly-lethal load), counting each
+rejection on ``kyverno_tpu_aot_load_rejected_total{reason}``.
+Integrity framing and eviction live one layer down in
+:class:`kyverno_tpu.aotcache.store.AotStore` — a corrupt or
 stale-codec entry decodes as a miss and is dropped, never raised.
 """
 
@@ -24,12 +33,15 @@ import logging
 import os
 import pickle
 import threading
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
+from ..aotcache import keys as _keys
 from ..aotcache.keys import executable_cache_key  # noqa: F401 (re-export)
 from ..aotcache.store import AotStore, default_store
 
 _log = logging.getLogger('kyverno.aotcache')
+
+AOT_LOAD_REJECTED = 'kyverno_tpu_aot_load_rejected_total'
 
 _CODEC_ZSTD = b'Z'
 _CODEC_ZLIB = b'D'
@@ -43,11 +55,25 @@ def _zstd():
         return None
 
 
+def _compile_meta() -> dict:
+    """The environment axes an executable is only loadable under."""
+    import jax
+    return {
+        'host_features': _keys.host_fingerprint(),
+        'env_scope': repr(_keys.env_scope()),
+        'jax': (jax.__version__, jax.lib.__version__),
+    }
+
+
 def encode_executable(compiled) -> bytes:
     """compiled executable → compressed blob (raises on failure)."""
     from jax.experimental import serialize_executable as se
     payload, in_tree, out_tree = se.serialize(compiled)
-    raw = pickle.dumps((payload, in_tree, out_tree))
+    return _pack_blob(payload, in_tree, out_tree, _compile_meta())
+
+
+def _pack_blob(payload, in_tree, out_tree, meta: dict) -> bytes:
+    raw = pickle.dumps((payload, in_tree, out_tree, meta))
     zstd = _zstd()
     if zstd is not None:
         return _CODEC_ZSTD + zstd.ZstdCompressor(level=3).compress(raw)
@@ -55,10 +81,9 @@ def encode_executable(compiled) -> bytes:
     return _CODEC_ZLIB + zlib.compress(raw, 3)
 
 
-def decode_executable(blob: bytes) -> Any:
-    """blob → loaded executable (raises on any mismatch — callers
-    treat that as a miss and drop the entry)."""
-    from jax.experimental import serialize_executable as se
+def _unpack_blob(blob: bytes) -> Tuple[Any, Any, Any, dict]:
+    """blob → (payload, in_tree, out_tree, meta); raises on any codec
+    or framing mismatch (callers treat that as ``undecodable``)."""
     codec, body = blob[:1], blob[1:]
     if codec == _CODEC_ZSTD:
         import zstandard
@@ -68,25 +93,85 @@ def decode_executable(blob: bytes) -> Any:
         raw = zlib.decompress(body)
     else:
         raise ValueError(f'unknown aot codec {codec!r}')
-    payload, in_tree, out_tree = pickle.loads(raw)
+    parts = pickle.loads(raw)
+    if len(parts) == 3:  # pre-meta frame: treat as stale
+        raise ValueError('legacy aot frame without compile meta')
+    return parts
+
+
+def _meta_mismatch(meta: dict) -> Optional[str]:
+    """Rejection reason when ``meta`` does not match this process."""
+    import jax
+    current = {
+        'host_features': ('feature_mismatch', _keys.host_fingerprint()),
+        'env_scope': ('env_mismatch', repr(_keys.env_scope())),
+        'jax': ('jax_mismatch',
+                (jax.__version__, jax.lib.__version__)),
+    }
+    for field, (reason, want) in current.items():
+        got = meta.get(field)
+        if got is None:
+            continue  # older frame missing this axis: key scoping holds
+        if isinstance(want, tuple):
+            got = tuple(got)
+        if got != want:
+            return reason
+    return None
+
+
+def decode_executable(blob: bytes) -> Any:
+    """blob → loaded executable (raises on any mismatch — callers
+    treat that as a miss and drop the entry)."""
+    from jax.experimental import serialize_executable as se
+    payload, in_tree, out_tree, _meta = _unpack_blob(blob)
     return se.deserialize_and_load(payload, in_tree, out_tree)
 
 
 # -- store orchestration ------------------------------------------------------
 
+def _count_rejection(reason: str) -> None:
+    from ..observability.metrics import global_registry
+    reg = global_registry()
+    if reg is not None:
+        reg.inc(AOT_LOAD_REJECTED, reason=reason)
+
+
+def _reject(store: AotStore, key: str, reason: str) -> None:
+    """Drop an unloadable entry and account for it: the caller falls
+    back to a fresh compile (persistent-XLA-cache assisted), which is
+    always safe — a forced load of a feature-mismatched executable can
+    SIGILL the process."""
+    _log.warning('aot entry %s rejected at load (%s); dropping',
+                 key[:12], reason)
+    store.delete(key)
+    _count_rejection(reason)
+
+
 def load_executable(key: str, store: Optional[AotStore] = None) -> Any:
     """Loaded executable for ``key`` or None.  A blob that fails to
-    decode (stale jax, torn write below the framing's resolution) is
-    deleted so the next process recompiles instead of re-failing."""
+    decode (stale jax, torn write below the framing's resolution), was
+    compiled under a different CPU-feature set / codegen env, or fails
+    XLA deserialization is deleted and counted on
+    ``aot_load_rejected_total`` so the next process recompiles instead
+    of re-failing (or worse, SIGILLing mid-request)."""
+    from jax.experimental import serialize_executable as se
     store = store or default_store()
     blob = store.load(key)
     if blob is None:
         return None
     try:
-        return decode_executable(blob)
+        payload, in_tree, out_tree, meta = _unpack_blob(blob)
     except Exception:  # noqa: BLE001 - stale/corrupt entry: recompile
-        _log.warning('aot entry %s undecodable; dropping', key[:12])
-        store.delete(key)
+        _reject(store, key, 'undecodable')
+        return None
+    reason = _meta_mismatch(meta if isinstance(meta, dict) else {})
+    if reason is not None:
+        _reject(store, key, reason)
+        return None
+    try:
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 - backend refused the artifact
+        _reject(store, key, 'deserialize_failed')
         return None
 
 
@@ -128,9 +213,15 @@ def flush_stores(timeout: float = 120.0) -> None:
         t.join(timeout)
 
 
-def evict_executable(key: str, store: Optional[AotStore] = None) -> None:
-    """Drop a poisoned entry from disk so the next call recompiles."""
+def evict_executable(key: str, store: Optional[AotStore] = None,
+                     reason: Optional[str] = None) -> None:
+    """Drop a poisoned entry from disk so the next call recompiles.
+    ``reason`` (e.g. ``execute_failed`` for artifacts that loaded but
+    died at dispatch — the machine-feature SIGILL class) also counts
+    the eviction on ``aot_load_rejected_total``."""
     (store or default_store()).delete(key)
+    if reason is not None:
+        _count_rejection(reason)
 
 
 def warm_cache_dir() -> Optional[str]:

@@ -535,8 +535,10 @@ def _walk(doc: Any, path: Tuple[str, ...]):
 
 
 class Batch:
-    def __init__(self, n: int):
+    def __init__(self, n: int, row_count: Optional[int] = None):
         self.n = n
+        #: live rows; rows [row_count, n) are canonical-capacity padding
+        self.row_count = n if row_count is None else row_count
         self.slot_lanes: Dict[Slot, Lanes] = {}
         self.array_meta: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
         self.gather_lanes: Dict[GatherSlot, Lanes] = {}
@@ -545,7 +547,14 @@ class Batch:
         self.elem_meta: Dict[Any, Dict[str, np.ndarray]] = {}
 
     def tensors(self) -> Dict[str, np.ndarray]:
-        out: Dict[str, np.ndarray] = {}
+        # the row-validity lane rides with every batch: the ragged
+        # evaluator masks the capacity-padding tail rows inside the
+        # jitted program (cross-row reductions — the mesh verdict
+        # summary, the compact fail-detail selection — must never read
+        # them), so one compiled capacity serves every occupancy
+        out: Dict[str, np.ndarray] = {
+            '__rowvalid__':
+                (np.arange(self.n) < self.row_count).astype(np.int8)}
         for i, (slot, lanes) in enumerate(self.slot_lanes.items()):
             out.update(lanes.tensors(f's{i}'))
         for j, (path, meta) in enumerate(self.array_meta.items()):
@@ -627,9 +636,14 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  contexts: Optional[List[dict]] = None) -> Batch:
     """``contexts`` overrides the per-resource gather context (admission
     scans thread operation/userInfo/oldObject through; defaults to the
-    background-scan context {'request': {'object': doc}})."""
+    background-scan context {'request': {'object': doc}}).
+
+    ``padded_n`` is a *capacity*: rows [len(resources), padded_n) stay
+    all-TAG_MISSING and are marked invalid on the ``__rowvalid__`` lane
+    (callers draw it from the canonical shape table —
+    ``compiler/shapes.py`` — so XLA only ever sees those shapes)."""
     n = max(len(resources), padded_n)
-    batch = Batch(n)
+    batch = Batch(n, row_count=len(resources))
     slot_needs, gather_needs, elem_needs, array_paths = _needs_cached(cps)
 
     # element width: sized to the longest observed list (pow-2 clamped) —

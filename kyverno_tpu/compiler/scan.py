@@ -34,6 +34,7 @@ from ..engine.match import matches_resource_description
 from ..observability import coverage
 from .compile import compile_policies
 from .encode import encode_batch
+from .shapes import canonical_capacity, canonical_caps
 from .ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
                  STATUS_SKIP_PRECOND, STATUS_VAR_ERR, CompiledPolicySet,
                  RuleProgram)
@@ -41,9 +42,10 @@ from .ir import (STATUS_FAIL, STATUS_HOST, STATUS_PASS, STATUS_SKIP,
 _SIMPLE_MATCH_KEYS = {'kinds', 'namespaces', 'operations'}
 
 #: the admission-shape warm resource: XLA compiles the evaluator once
-#: per batch-shape bucket and the element axis clamps to a minimum of
-#: 4, so one ≤4-container warm pod covers every ≤4-container admission
-#: request (the common case); larger pods lazily compile their bucket
+#: per canonical batch capacity (compiler/shapes.py) and the element
+#: axis clamps to a minimum of 4, so one ≤4-container warm pod covers
+#: every ≤4-container admission request (the common case); larger pods
+#: lazily compile their element width
 WARM_POD = {
     'apiVersion': 'v1', 'kind': 'Pod',
     'metadata': {'name': 'warm', 'namespace': 'default'},
@@ -252,6 +254,56 @@ class BatchScanner:
         self.scan([copy.deepcopy(r) for r in (resources or [WARM_POD])])
         return time.monotonic() - t0
 
+    def warmup_shapes(self, caps: Optional[List[int]] = None
+                      ) -> Dict[int, float]:
+        """Bring EVERY canonical batch capacity to serving readiness.
+
+        One warm dispatch per capacity in the canonical shape table
+        (``compiler/shapes.py``), run on a small thread pool: each
+        dispatch drives the evaluator with exactly the tensor signature
+        a real scan at that capacity produces (lanes + ``__rowvalid__``
+        + the unique-space ``__match__`` plane), so the executable
+        lookup — persistent AOT store first, fresh compile otherwise —
+        is the one live traffic will hit.  Deserializes don't hold the
+        evaluator's compile lock, so a warm disk cache loads the whole
+        table in ~max(entry) instead of sum(entries).  Returns
+        {capacity: seconds}."""
+        import copy
+        from concurrent.futures import ThreadPoolExecutor
+        from ..ops.eval import shard_batch
+        if not self.cps.programs:
+            return {}
+        table = sorted(set(caps if caps is not None else canonical_caps(
+            chunk=self.CHUNK, small=self.SMALL_BATCH)))
+
+        def warm_one(cap: int) -> float:
+            t0 = time.monotonic()
+            batch = encode_batch([copy.deepcopy(WARM_POD)], self.cps,
+                                 padded_n=cap)
+            tensors = batch.tensors()
+            if self.mesh is None:
+                # mirror dispatch_work: non-mesh dispatches always ship
+                # the unique-space match plane (values are irrelevant
+                # for warming; the SIGNATURE selects the executable)
+                tensors['__match__'] = np.zeros(
+                    (cap, self._evaluator.n_uniq), np.uint8)
+            device = self._small_device() \
+                if self.mesh is None and cap <= self.SMALL_BATCH else None
+            t, layout = shard_batch(tensors, self.mesh, device=device)
+            out = self._evaluator(t, layout)
+            for arr in out:
+                np.asarray(arr)  # materialize before freeing inputs
+            self._free_inputs(t, out)
+            return time.monotonic() - t0
+
+        if len(table) <= 1:
+            return {cap: warm_one(cap) for cap in table}
+        with ThreadPoolExecutor(
+                max_workers=min(4, len(table)),
+                thread_name_prefix='ktpu-shape-warm') as pool:
+            futs = [(cap, pool.submit(warm_one, cap)) for cap in table]
+            return {cap: f.result() for cap, f in futs}
+
     # -- match --------------------------------------------------------------
 
     def _policy_gate(self, policy: Policy, res: Resource) -> bool:
@@ -454,10 +506,16 @@ class BatchScanner:
             part = resources[start:start + chunk]
             part_ctx = contexts[start:start + chunk] \
                 if contexts is not None else None
-            # bucketed padding: power-of-two buckets below one chunk,
-            # exactly CHUNK otherwise → few compiled shapes total
-            bucket = chunk if n > chunk else \
-                max(64, 1 << (len(part) - 1).bit_length())
+            # canonical capacity padding (compiler/shapes.py): every
+            # part pads to one of the few canonical row shapes and the
+            # evaluator masks the tail rows via the __rowvalid__ lane,
+            # so XLA never sees a new shape whatever the occupancy.
+            # Multi-chunk scans pin every part (tail included) to the
+            # chunk capacity: their dispatches skip the small-batch CPU
+            # placement, so a canonically-small tail would otherwise
+            # compile one extra shape on the accelerator backend.
+            bucket = chunk if n > chunk else canonical_capacity(
+                len(part), chunk=chunk, small=self.SMALL_BATCH)
             if use_procs:
                 try:
                     async_res = self._encoder_pool.submit(part, part_ctx,

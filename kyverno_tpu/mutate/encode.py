@@ -16,6 +16,12 @@ comparisons read.  Per (resource, site):
   sbytes   u8[W] first bytes of a string leaf (W sized to the longest
                 string patch constant in the program)
 
+Plus one per-resource lane:
+
+  valid    bool row is a live resource (False = canonical-capacity
+                padding; the kernel masks padding rows so their edit
+                bitmasks and statuses are identically empty)
+
 The walk mirrors ``mutate_compile._apply_sets``' decision loop byte for
 byte — non-map intermediates, null-as-creatable intermediates, and the
 leaf-parent map check — so a device verdict can only ever differ from
@@ -114,8 +120,9 @@ def encode_mutate_batch(resources: List[dict],
                         padded_n: int = 0,
                         width: int = 0) -> Dict[str, np.ndarray]:
     """Lane tensors for ``resources`` over the program's edit sites.
-    Padding rows encode as all-MISSING (every edit "applies"); callers
-    only decode the first ``len(resources)`` rows."""
+    ``padded_n`` is a canonical capacity (``compiler/shapes.py``):
+    padding rows encode as all-MISSING and carry ``valid=False``, so
+    the kernel's edit bitmasks ignore them entirely."""
     sites: List[EditSite] = [s for prog in program.programs
                              for s in prog.sites]
     n = max(len(resources), padded_n)
@@ -128,6 +135,7 @@ def encode_mutate_batch(resources: List[dict],
         'milli_ok': np.zeros((n, s), bool),
         'slen': np.zeros((n, s), np.int32),
         'sbytes': np.zeros((n, s, w), np.uint8),
+        'valid': np.arange(n) < len(resources),
     }
     for r, doc in enumerate(resources):
         for k, site in enumerate(sites):

@@ -22,7 +22,11 @@ outputs:
 
 The kernel is intentionally tiny (a few element-wise ops and one
 matmul-shaped reduction per output) — it is not AOT-persisted; XLA
-compiles it once per padded batch bucket.
+compiles it once per canonical batch capacity (compiler/shapes.py).
+Rows past the live row count (the ``valid`` lane) are capacity
+padding: their statuses, edit bitmasks, and reasons are forced to
+SKIP/0 inside the jitted program so no cross-row consumer can ever
+observe them.
 """
 
 from __future__ import annotations
@@ -131,6 +135,15 @@ class MutateKernel:
             jnp.where(bad_any, RC_NON_DICT,
                       jnp.where(undec_any, RC_UNDECIDABLE,
                                 RC_NONE))).astype(jnp.int8)
+        # ragged batches: capacity-padding rows (all-MISSING leaves
+        # would otherwise read as "every edit applies") are masked to
+        # SKIP / empty-bitmask / no-reason inside the program
+        valid = lanes.get('valid')
+        if valid is not None:
+            vcol = valid[:, None]
+            status = jnp.where(vcol, status, MUT_SKIP).astype(jnp.int8)
+            edits = jnp.where(vcol, edits, 0)
+            reason = jnp.where(vcol, reason, RC_NONE).astype(jnp.int8)
         return status, edits, reason
 
     def __call__(self, lanes: Dict[str, np.ndarray]

@@ -111,10 +111,6 @@ class MutateScanner:
 
     # -- scan -------------------------------------------------------------
 
-    @staticmethod
-    def _bucket(n: int) -> int:
-        return max(8, 1 << (max(n, 1) - 1).bit_length())
-
     def scan(self, resources: List[dict],
              contexts: Optional[List[dict]] = None,
              admission: Optional[tuple] = None,
@@ -139,8 +135,12 @@ class MutateScanner:
         with tracing.start_span('kyverno/mutate/patch_emit',
                                 {'rows': n,
                                  'sites': self.program.n_sites}):
+            # canonical capacity (compiler/shapes.py): the kernel masks
+            # padding rows via the `valid` lane, so one compiled shape
+            # serves every admission occupancy
+            from ..compiler.shapes import canonical_capacity
             lanes = encode_mutate_batch(resources, self.program,
-                                        padded_n=self._bucket(n),
+                                        padded_n=canonical_capacity(n),
                                         width=self._width)
             status, edits, reason = self._kernel(lanes)
         if registry is not None:

@@ -104,6 +104,12 @@ METRICS: Dict[str, Metric] = {
         '(KTPU_AOT_CACHE_DIR).'),
     'kyverno_tpu_aot_cache_entries': Metric(
         'gauge', 'Persisted AOT executable entries on disk.'),
+    'kyverno_tpu_aot_load_rejected_total': Metric(
+        'counter', 'AOT store entries dropped instead of loaded; '
+        'reason=undecodable|feature_mismatch|env_mismatch|jax_mismatch|'
+        'deserialize_failed|execute_failed (a rejected entry falls back '
+        'to a fresh persistent-XLA-cache-assisted compile, never a '
+        'possibly-SIGILL load).'),
     # device-side mutate (kyverno_tpu/mutate/scanner.py)
     'kyverno_tpu_mutate_patch_emit_seconds': Metric(
         'histogram', 'Mutate patch-emit stage: encode the edit-site '

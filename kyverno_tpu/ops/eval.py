@@ -1666,6 +1666,12 @@ def build_evaluator(cps: CompiledPolicySet):
         # compile_lock serializes every trace, and the AOT cache key
         # bakes the batch layout into the executable's identity
         t = unpack_batch(packed, layout_holder['layout'])
+        # ragged batches: rows past the live row count are canonical-
+        # capacity padding.  Per-row outputs for them are sliced off on
+        # the host; everything that selects or reduces ACROSS rows in
+        # the graph masks them here so one compiled capacity serves
+        # every occupancy with bit-identical output.
+        rowvalid = t.pop('__rowvalid__', None)
         match = t.pop('__match__', None)
         if match is None:
             return evaluate(t)
@@ -1675,6 +1681,8 @@ def build_evaluator(cps: CompiledPolicySet):
         # host expands duplicates with one gather (expand_compact)
         s_u, d_u, fdet_u = evaluate_unique(t)
         rel_main = (s_u == FAIL) & (match != 0)
+        if rowvalid is not None:
+            rel_main = rel_main & (rowvalid != 0)[:, None]
         parts = [rel_main]
         for u, cnt in uniq_any:
             parts.append(jnp.broadcast_to(rel_main[:, u:u + 1],
@@ -1723,20 +1731,32 @@ def build_evaluator(cps: CompiledPolicySet):
             if hit is not None:
                 devtel.record_cache('hit')
                 return hit
-            with devtel.stage('compile') as st:
-                loaded = aot.load_executable(key)
-                if loaded is not None:
-                    devtel.record_cache('aot_load')
-                    st.set_attribute('cache', 'aot_load')
-                else:
-                    layout_holder['layout'] = layout
-                    loaded = jitted.lower(packed).compile()
-                    devtel.record_cache('miss')
-                    st.set_attribute('cache', 'miss')
-                    aot.store_executable_async(key, loaded)
-                    devtel.record_cache('aot_store')
-            exec_cache[key] = loaded
-            return loaded
+        # the disk deserialize runs OUTSIDE the compile lock: it never
+        # touches layout_holder, and the shape warmer loads the
+        # canonical capacities on a thread pool — serializing the
+        # (tens-of-seconds) deserializes here would make warm-up a sum
+        # instead of a max.  Two racers on ONE key at worst both
+        # deserialize; setdefault keeps a single winner.
+        with devtel.stage('compile') as st:
+            loaded = aot.load_executable(key)
+            if loaded is not None:
+                devtel.record_cache('aot_load')
+                st.set_attribute('cache', 'aot_load')
+                with compile_lock:
+                    return exec_cache.setdefault(key, loaded)
+            with compile_lock:
+                hit = exec_cache.get(key)
+                if hit is not None:
+                    devtel.record_cache('hit')
+                    return hit
+                layout_holder['layout'] = layout
+                loaded = jitted.lower(packed).compile()
+                devtel.record_cache('miss')
+                st.set_attribute('cache', 'miss')
+                aot.store_executable_async(key, loaded)
+                devtel.record_cache('aot_store')
+                exec_cache[key] = loaded
+                return loaded
 
     def _evict_aot(packed) -> None:
         """Drop a poisoned AOT entry (memory + disk) so the next call
@@ -1748,7 +1768,7 @@ def build_evaluator(cps: CompiledPolicySet):
             return
         with compile_lock:
             exec_cache.pop(key, None)
-        aot.evict_executable(key)
+        aot.evict_executable(key, reason='execute_failed')
 
     def call(packed: Dict[str, Any],
              layout: Dict[str, Tuple[str, int, int, Tuple[int, ...]]]):

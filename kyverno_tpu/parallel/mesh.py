@@ -56,7 +56,9 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
 
     def step(packed: Dict[str, jnp.ndarray]):
         t = unpack_batch(packed, evaluator.layout_holder['layout'])
-        rowmask = t.pop('__rowmask__', None)
+        # the encoder's row-validity lane: canonical-capacity padding
+        # rows must not count in the cross-shard verdict summary
+        rowmask = t.pop('__rowvalid__', None)
         # fdet is dropped here: the distributed summary path never
         # synthesizes messages, and leaving it out of the jit outputs
         # lets XLA DCE the whole fail-site computation
@@ -65,7 +67,8 @@ def build_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh,
         # the partial sums are psum-reduced over ICI automatically
         one_hot = jax.nn.one_hot(statuses, n_codes, dtype=jnp.int32)
         if rowmask is not None:
-            one_hot = one_hot * rowmask[:, None, None]
+            one_hot = one_hot * (rowmask != 0).astype(
+                jnp.int32)[:, None, None]
         summary = jnp.sum(one_hot, axis=0)
         return statuses, details, summary
 
@@ -137,17 +140,19 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
                           resources: List[dict], axis: str = 'data'):
     """Encode + evaluate a batch across the mesh; returns (statuses, summary).
 
-    The batch is padded to a multiple of the mesh size so every shard gets
-    identical shapes (padded rows are TAG_MISSING and sliced off).
+    The batch pads to the canonical capacity (``compiler/shapes.py``),
+    rounded up to a multiple of the mesh size so every shard gets
+    identical shapes; the encoder's ``__rowvalid__`` lane keeps the
+    padding rows out of the verdict summary.
     """
     from ..compiler.encode import encode_batch
+    from ..compiler.shapes import canonical_capacity
     n = len(resources)
     n_dev = mesh.devices.size
-    padded = pad_to_multiple(max(n, n_dev), n_dev)
+    padded = pad_to_multiple(
+        max(canonical_capacity(max(n, n_dev)), n), n_dev)
     batch = encode_batch(resources, cps, padded_n=padded)
     raw = batch.tensors()
-    # padded rows are excluded from the verdict summary
-    raw['__rowmask__'] = (np.arange(padded) < n).astype(np.int32)
     tensors, layout = shard_tensors(raw, mesh, axis)
     step = _cached_sharded_evaluator(cps, mesh, axis)
     statuses, details, summary = step(tensors, layout)

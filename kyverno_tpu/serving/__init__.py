@@ -9,9 +9,10 @@ evaluation).
 
 * :mod:`.queue` — bounded request queue with per-request futures;
 * :mod:`.batcher` — the coalescing loop (flush on the
-  ``KTPU_BATCH_WINDOW_MS`` deadline or at ``KTPU_BATCH_MAX`` occupancy,
-  which matches the compiled small-batch bucket so batching introduces
-  no new XLA shapes);
+  ``KTPU_BATCH_WINDOW_MS`` deadline or at ``KTPU_BATCH_MAX`` occupancy;
+  batches are ragged — padded to a canonical capacity with the tail
+  masked in-graph — so a flush at any occupancy reuses a compiled
+  executable);
 * :mod:`.shed` — the degradation policy: queue-full, deadline-blown, or
   scan-failed requests shed to the host engine loop (identical
   verdicts, never a 500).

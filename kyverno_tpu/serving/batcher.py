@@ -3,11 +3,17 @@
 One daemon thread watches the bounded queue.  It picks the oldest
 pending ticket, waits until that ticket's flush window expires
 (``KTPU_BATCH_WINDOW_MS``, default ~2ms) or its key reaches
-``KTPU_BATCH_MAX`` occupancy (default 64 — the compiled small-batch
-bucket floor in ``compiler/scan.py``, so a full batch pads exactly like
-a sync scan and introduces no new XLA shapes), then dispatches all
-claimed tickets of that key as ONE ``scanner.scan`` call and resolves
-their futures row by row.
+``KTPU_BATCH_MAX`` occupancy (default: the small canonical batch
+capacity, ``compiler/shapes.py``), then dispatches all claimed tickets
+of that key as ONE ``scanner.scan`` call and resolves their futures
+row by row.
+
+Batches are ragged: the scanner pads every dispatch to a canonical
+capacity and the evaluator masks the tail rows in-graph, so a flush at
+ANY occupancy reuses an already-compiled executable — there is no
+bucket floor to align with, and ``KTPU_BATCH_MAX`` is purely a
+latency/amortization trade (values above the small capacity make
+batches pad to the next canonical capacity).
 
 Dispatches are serialized on the batcher thread: ``BatchScanner.scan``
 keeps per-scan state on the scanner instance, and one consumer at a
@@ -76,7 +82,15 @@ class AdmissionBatcher:
         if window_ms is None:
             window_ms = float(os.environ.get('KTPU_BATCH_WINDOW_MS', '2'))
         if max_batch is None:
-            max_batch = int(os.environ.get('KTPU_BATCH_MAX', '64'))
+            raw_max = os.environ.get('KTPU_BATCH_MAX', '')
+            if raw_max.strip():
+                max_batch = int(raw_max)
+            else:
+                # default: fill the small canonical capacity exactly —
+                # any occupancy is shape-safe (ragged batches), this is
+                # just the point past which padding jumps capacities
+                from ..compiler.shapes import small_capacity
+                max_batch = small_capacity()
         if queue_cap is None:
             queue_cap = int(os.environ.get('KTPU_QUEUE_CAP', '256'))
         if shed_deadline_ms is None:

@@ -172,6 +172,44 @@ def test_ktpu203_positive_negative(tmp_path):
     assert not rep.active
 
 
+def test_ktpu204_positive_negative(tmp_path):
+    # the retired power-of-two bucket ladder regrowing: flagged
+    rep = run(tmp_path, {'a.py': """\
+    from .encode import encode_batch
+
+    def work(docs, cps, n):
+        bucket = max(64, 1 << (n - 1).bit_length())
+        return encode_batch(docs, cps, padded_n=bucket)
+    """}, rules=['KTPU204'])
+    assert rule_ids(rep) == {'KTPU204'}
+    # a hard-coded row count is a shape too
+    rep = run(tmp_path, {'a.py': """\
+    from .encode import encode_mutate_batch
+
+    def work(docs, program):
+        return encode_mutate_batch(docs, program, padded_n=4096)
+    """}, rules=['KTPU204'])
+    assert rule_ids(rep) == {'KTPU204'}
+    # canonical-table provenance: clean
+    rep = run(tmp_path, {'a.py': """\
+    from .encode import encode_batch
+    from .shapes import canonical_capacity
+
+    def work(docs, cps, n):
+        bucket = canonical_capacity(n)
+        return encode_batch(docs, cps, padded_n=bucket)
+    """}, rules=['KTPU204'])
+    assert not rep.active
+    # unpadded (padded_n absent / 0) encodes are not shape decisions
+    rep = run(tmp_path, {'a.py': """\
+    from .encode import encode_batch
+
+    def work(docs, cps):
+        return encode_batch(docs, cps, padded_n=0)
+    """}, rules=['KTPU204'])
+    assert not rep.active
+
+
 # -- KTPU3xx: fallback taxonomy ----------------------------------------------
 
 def test_ktpu301_positive_negative(tmp_path):
@@ -544,9 +582,9 @@ def test_baseline_survives_line_drift(tmp_path):
 
 def test_rule_registry_complete():
     expected = {'KTPU001', 'KTPU002', 'KTPU101', 'KTPU102', 'KTPU103',
-                'KTPU201', 'KTPU202', 'KTPU203', 'KTPU301', 'KTPU302',
-                'KTPU303', 'KTPU401', 'KTPU402', 'KTPU501', 'KTPU502',
-                'KTPU503', 'KTPU504', 'KTPU505'}
+                'KTPU201', 'KTPU202', 'KTPU203', 'KTPU204', 'KTPU301',
+                'KTPU302', 'KTPU303', 'KTPU401', 'KTPU402', 'KTPU501',
+                'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
