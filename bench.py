@@ -155,6 +155,15 @@ MUTATE_DEVICE_RATIO_FLOOR = 0.75
 #: that zoo at warm_s 49-93s / cache_warm_s 92.7s against ~28s of scan)
 WARM_EXECUTABLES_MAX = 2
 
+#: heterogeneous-traffic ratchet for ``bench.py --admission-concurrency``:
+#: mean batch occupancy under the synthetic cluster generator (zipfian
+#: users/namespaces, mixed verbs, exception tenants —
+#: conformance/loadgen.py) must EXCEED this floor at the highest thread
+#: count.  The batch key is the policy set alone (per-row admission
+#: lanes); before that change heterogeneous traffic degenerated to
+#: batch-of-one, so this committed floor is what keeps it fixed.
+HET_OCCUPANCY_FLOOR = 2.0
+
 _IMAGES = ['nginx:1.25.3', 'nginx:latest', 'ghcr.io/org/app:v2.1',
            'redis:7', 'docker.io/library/busybox', 'gcr.io/proj/svc:prod',
            'app', 'registry.internal:5000/team/api:canary']
@@ -887,6 +896,11 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     # same compiled serving chain
     _progress('concurrent admission (batch serving)')
     adm_concurrency = admission_concurrency(adm_ctx, sieve_pods)
+
+    # heterogeneous traffic from the synthetic cluster generator: the
+    # scanner-only batch key is what this block tracks (and ratchets)
+    _progress('heterogeneous admission (synthetic cluster load)')
+    adm_hetero = admission_heterogeneous(adm_ctx)
     adm_ctx[1].shutdown()
 
     # rescan churn block (CI-sized; the O(churn) verdict-cache claim —
@@ -952,6 +966,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'admission_n_policies': lat_n_policies,
         'admission_device_served': adm_device,
         'admission_concurrency': adm_concurrency,
+        'admission_heterogeneous': adm_hetero,
         'rescan': rescan_block,
     }
     if warning:
@@ -1122,6 +1137,184 @@ def admission_concurrency(ctx, resources, thread_counts=None,
         if prov_owned:
             provenance.disable()
     return blocks
+
+
+def admission_heterogeneous(ctx, thread_counts=None,
+                            requests_per_thread=25):
+    """Heterogeneous-traffic serving bench: drive the batch-mode chain
+    with the synthetic cluster generator (zipfian users/namespaces,
+    mixed CREATE/UPDATE verbs, exception-holding tenants —
+    kyverno_tpu/conformance/loadgen.py).  The batch key is the policy
+    set alone, so mean occupancy under MIXED admission tuples is the
+    tracked number; THE RATCHET: at the highest thread count it must
+    exceed ``HET_OCCUPANCY_FLOOR`` (before per-row admission lanes this
+    traffic was batch-of-one by construction).  A paced single-client
+    ``trickle`` pass closes the block as the occupancy-1 sanity
+    anchor."""
+    import threading
+    from kyverno_tpu.conformance.loadgen import SyntheticCluster
+    from kyverno_tpu.observability import provenance
+    server, handlers, _n_replicated, device_served = ctx
+    if thread_counts is None:
+        spec = os.environ.get('BENCH_ADMISSION_THREADS', '1,8,32')
+        thread_counts = [int(t) for t in spec.split(',') if t.strip()]
+    cluster = SyntheticCluster(seed=1234)
+    exc_docs = cluster.exception_docs()
+    prior_mode = handlers.serving_mode
+    handlers.serving_mode = 'batch'
+    pc_builder = handlers.pc_builder
+    prior_build = pc_builder.build
+
+    def build(request, policy=None):
+        pctx = prior_build(request, policy)
+        ui = request.get('userInfo') or {}
+        if cluster.is_exception_tenant(ui.get('username', '')):
+            # exception-holding tenants ride the host engine loop (the
+            # placeholder exceptions match no policy, so every verdict
+            # is unchanged — only the serving path shifts)
+            pctx.exceptions = list(exc_docs)
+        return pctx
+
+    pc_builder.build = build
+    recorder = provenance.recorder()
+    prov_owned = recorder is None
+    if prov_owned:
+        recorder = provenance.configure(flight_n=max(
+            16384, 2 * max(thread_counts) * requests_per_thread))
+    blocks = []
+    try:
+        base = 0
+        for n_threads in thread_counts:
+            reviews = [cluster.review_bytes(base + k)
+                       for k in range(n_threads * requests_per_thread)]
+            base += len(reviews)
+            batcher = handlers._get_batcher()
+            batcher.reset_stats()
+            if recorder is not None:
+                recorder.reset()
+            barrier = threading.Barrier(n_threads + 1)
+
+            def work(tid, reviews=reviews):
+                barrier.wait()
+                for k in range(requests_per_thread):
+                    server.handle(
+                        '/validate/fail',
+                        reviews[tid * requests_per_thread + k])
+
+            threads = [threading.Thread(target=work, args=(tid,))
+                       for tid in range(n_threads)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.time()
+            for t in threads:
+                t.join()
+            elapsed = time.time() - t0
+            stats = batcher.stats()
+            decisions = n_threads * requests_per_thread
+            blocks.append({
+                'threads': n_threads,
+                'decisions_per_s': round(decisions / elapsed, 1)
+                if elapsed > 0 else 0.0,
+                'batch_occupancy_mean': round(stats['occupancy_mean'],
+                                              2),
+                'batch_occupancy_p50': stats['occupancy_p50'],
+                'hetero_dispatches': stats['hetero_dispatches'],
+                'hetero_occupancy_mean': round(
+                    stats['hetero_occupancy_mean'], 2),
+                'queue_wait_p50_ms': round(stats['queue_wait_p50_ms'],
+                                           3),
+                'shed_total': stats['shed_total'],
+                'device_served': device_served,
+                'decision_breakdown': provenance.breakdown(),
+            })
+            _progress(
+                f'admission hetero: {n_threads} threads -> '
+                f"{blocks[-1]['decisions_per_s']}/s, occupancy mean "
+                f"{blocks[-1]['batch_occupancy_mean']} "
+                f"(hetero dispatches {blocks[-1]['hetero_dispatches']})")
+        # batch-of-one baseline: the SAME heterogeneous traffic at the
+        # top thread count with per-request dispatches (sync mode) —
+        # what every mixed-tuple request paid before the batch key
+        # collapsed to the policy set
+        top = max(thread_counts)
+        reviews = [cluster.review_bytes(base + k)
+                   for k in range(top * requests_per_thread)]
+        base += len(reviews)
+        handlers.serving_mode = 'sync'
+        try:
+            barrier = threading.Barrier(top + 1)
+
+            def sync_work(tid, reviews=reviews):
+                barrier.wait()
+                for k in range(requests_per_thread):
+                    server.handle('/validate/fail',
+                                  reviews[tid * requests_per_thread + k])
+
+            threads = [threading.Thread(target=sync_work, args=(tid,))
+                       for tid in range(top)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.time()
+            for t in threads:
+                t.join()
+            sync_elapsed = time.time() - t0
+        finally:
+            handlers.serving_mode = 'batch'
+        baseline = {
+            'threads': top,
+            'decisions_per_s': round(
+                top * requests_per_thread / sync_elapsed, 1)
+            if sync_elapsed > 0 else 0.0,
+        }
+        top_block = max(blocks, key=lambda b: b['threads'])
+        baseline['batched_speedup'] = round(
+            top_block['decisions_per_s'] / baseline['decisions_per_s'],
+            2) if baseline['decisions_per_s'] else None
+        _progress(f"admission hetero baseline (sync, {top} threads): "
+                  f"{baseline['decisions_per_s']}/s -> batched speedup "
+                  f"{baseline['batched_speedup']}x")
+        # trickle anchor: one paced client must flush batches of one
+        batcher = handlers._get_batcher()
+        batcher.reset_stats()
+        for delay, body in cluster.arrivals(40, pattern='trickle',
+                                            rate_per_s=200.0,
+                                            start=base):
+            time.sleep(delay)
+            server.handle('/validate/fail', body)
+        tstats = batcher.stats()
+        trickle = {
+            'requests': 40,
+            'batch_occupancy_p50': tstats['occupancy_p50'],
+            'batch_occupancy_mean': round(tstats['occupancy_mean'], 2),
+        }
+        floor_block = max(blocks, key=lambda b: b['threads'])
+        ratchet_checked = bool(device_served and
+                               floor_block['threads'] >= 8)
+        if ratchet_checked:
+            occ = floor_block['batch_occupancy_mean']
+            # THE RATCHET: heterogeneous coalescing must not regress to
+            # batch-of-one
+            if occ <= HET_OCCUPANCY_FLOOR:
+                raise AssertionError(
+                    f'heterogeneous mean batch occupancy {occ} at '
+                    f"{floor_block['threads']} threads is at/below the "
+                    f'committed floor {HET_OCCUPANCY_FLOOR}')
+        return {'blocks': blocks, 'trickle': trickle,
+                'batch_of_one_baseline': baseline,
+                'generator': {'seed': cluster.seed,
+                              'users': len(cluster.users),
+                              'namespaces': len(cluster.namespaces),
+                              'exception_tenants':
+                                  len(cluster.exception_users)},
+                'ratchet_floor': HET_OCCUPANCY_FLOOR,
+                'ratchet_checked': ratchet_checked}
+    finally:
+        pc_builder.build = prior_build
+        handlers.serving_mode = prior_mode
+        if prov_owned:
+            provenance.disable()
 
 
 # --------------------------------------------------------------------------
@@ -1477,11 +1670,14 @@ def admission_concurrency_main(platform: str) -> int:
     _progress(f'admission serving chain @{target} policies')
     ctx = _admission_server(policies, pods, target_policies=target)
     blocks = admission_concurrency(ctx, pods)
+    _progress('heterogeneous admission (synthetic cluster load)')
+    hetero = admission_heterogeneous(ctx)
     ctx[1].shutdown()
     print(json.dumps({
         'metric': 'admission_concurrency', 'platform': platform,
         'n_policies': ctx[2], 'device_served': ctx[3],
         'admission_concurrency': blocks,
+        'admission_heterogeneous': hetero,
     }))
     return 0
 

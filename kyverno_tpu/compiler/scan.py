@@ -32,6 +32,7 @@ from ..engine.api import (EngineResponse, PolicyContext, RuleResponse,
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
 from ..observability import coverage
+from . import admission as admission_lanes
 from .compile import compile_policies
 from .encode import encode_batch
 from .shapes import canonical_capacity, canonical_caps
@@ -57,6 +58,18 @@ PRECONDITIONS_SKIP_MESSAGE = 'preconditions not met'
 
 # sentinel: a device cell that must be re-run on the host engine
 _HOST_MARKER = object()
+
+#: process-unique monotonic scanner ids for batch coalescing keys —
+#: ``id()`` can be reused after GC/eviction, which would let a fresh
+#: scanner's tickets coalesce with a dead scanner's batch
+_SCANNER_SERIALS = __import__('itertools').count(1)
+
+
+def next_scanner_serial() -> int:
+    """Next monotonic scanner serial (itertools.count: atomic in
+    CPython).  Shared by BatchScanner and MutateScanner so the two
+    program kinds can never collide on a serving key."""
+    return next(_SCANNER_SERIALS)
 
 # ---------------------------------------------------------------------------
 # Encoder process pool: encode_batch is pure numpy/Python (no jax), so
@@ -209,6 +222,16 @@ class BatchScanner:
         self.fingerprint = policy_set_fingerprint(policies)
         from ..ops.eval import build_evaluator
         self._evaluator = build_evaluator(self.cps)
+        # per-row admission lanes (compiler/admission.py): the serving
+        # batch key is the scanner alone, so mixed-user/mixed-verb
+        # bursts share one dispatch; the evaluator owns the compiled
+        # table (single source — the lane signature and the in-graph
+        # decision can never disagree)
+        self.serial = next_scanner_serial()
+        self.supports_row_admissions = True
+        self._adm = getattr(self._evaluator, 'adm_table', None)
+        self._adm_cols = self._evaluator.adm_cols \
+            if self._adm is not None else None
         from collections import OrderedDict
         self._simple_match = [
             _rule_match_is_simple(p.rule_raw or {}) for p in self.cps.programs]
@@ -287,6 +310,10 @@ class BatchScanner:
                 # for warming; the SIGNATURE selects the executable)
                 tensors['__match__'] = np.zeros(
                     (cap, self._evaluator.n_uniq), np.uint8)
+                if self._adm is not None:
+                    # admission lanes are part of the signature too
+                    tensors.update(admission_lanes.zero_lanes(
+                        self._adm, cap))
             device = self._small_device() \
                 if self.mesh is None and cap <= self.SMALL_BATCH else None
             t, layout = shard_batch(tensors, self.mesh, device=device)
@@ -319,46 +346,91 @@ class BatchScanner:
         return matches_resource_description(
             res, self._rules[j], info, roles, ns_labels, '') is None
 
+    def _mcache_get(self, key):
+        with self._match_cache_lock:
+            hit = self._match_cache.get(key)
+            if hit is not None:
+                self._match_cache.move_to_end(key)
+            return hit
+
+    def _mcache_put(self, key, value):
+        with self._match_cache_lock:
+            while len(self._match_cache) >= self._match_cache_max:
+                self._match_cache.popitem(last=False)
+            self._match_cache[key] = value
+
+    def _adm_res_atoms(self, resources: List[dict],
+                       wrapped: List[Resource]) -> np.ndarray:
+        """[R, F] uint8 resource-shape atoms for the admission-eligible
+        filters (compiler/admission.py), group-cached: eligible filters
+        only reference kinds/namespaces/operations plus the policy
+        namespace gate, all functions of the resource group."""
+        table = self._adm
+        n = len(resources)
+        out = np.zeros((n, len(table.atoms)), np.uint8)
+        groups: Dict[Tuple, List[int]] = {}
+        for i, doc in enumerate(resources):
+            groups.setdefault(_group_key(doc), []).append(i)
+        for key, idxs in groups.items():
+            ck = ('admres',) + key
+            cached = self._mcache_get(ck)
+            if cached is None:
+                rep = wrapped[idxs[0]]
+                cached = np.array([
+                    1 if admission_lanes.atom_ok(
+                        a, self.policies[a.policy_index], rep) else 0
+                    for a in table.atoms], np.uint8)
+                self._mcache_put(ck, cached)
+            out[idxs, :] = cached
+        return out
+
     def match_matrix(self, resources: List[dict], wrapped: List[Resource],
-                     admission: Optional[tuple] = None) -> np.ndarray:
+                     admission: Optional[tuple] = None,
+                     adm_rows: Optional[List[Optional[tuple]]] = None,
+                     plan: Optional[Any] = None) -> np.ndarray:
         """[R, P] bool match mask, group-cached for simple-match rules.
-        ``admission`` carries (admission_info, exclude_group_roles,
-        namespace_labels, operation) for webhook scans; simple-match
-        rules only reference kinds/namespaces/operations, so the group
-        cache stays valid with the operation folded into the key."""
+        ``admission`` carries one scan-wide (admission_info,
+        exclude_group_roles, namespace_labels, operation) tuple;
+        ``adm_rows`` carries one PER ROW (heterogeneous webhook
+        batches).  Simple-match rules only reference
+        kinds/namespaces/operations, so the group cache stays valid
+        across mixed users with each row's operation folded into its
+        own key.  ``plan`` (AdmissionRowPlan) marks rows whose
+        admission-eligible columns the jitted evaluator will decide
+        in-graph: those cells hold the conservative upper bound here
+        and are replaced with the exact device decision before
+        assembly; non-valid rows (unencodable admission values, UPDATE
+        rows) fall back to the host matcher per row."""
         n = len(resources)
         p = len(self.cps.programs)
         match = np.zeros((n, p), bool)
         if p == 0:
             return match
         simple = np.asarray(self._simple_match)
-        operation = admission[3] if admission else ''
-        adm3 = admission[:3] if admission else None
-        # group resources by (kind, apiVersion, namespace, operation)
+        if adm_rows is None and admission is not None:
+            adm_rows = [admission] * n
+        if adm_rows is not None:
+            ops = [a[3] if isinstance(a, tuple) and len(a) > 3 else ''
+                   for a in adm_rows]
+            adm3s = [tuple(a[:3]) if isinstance(a, tuple) else None
+                     for a in adm_rows]
+        else:
+            ops = [''] * n
+            adm3s: List[Optional[tuple]] = [None] * n
+        # group resources by (kind, apiVersion, namespace, operation) —
+        # per-row operations, so mixed-verb batches group correctly
         groups: Dict[Tuple, List[int]] = {}
         for i, doc in enumerate(resources):
-            groups.setdefault(_group_key(doc) + (operation,), []).append(i)
-        def cache_get(key):
-            with self._match_cache_lock:
-                hit = self._match_cache.get(key)
-                if hit is not None:
-                    self._match_cache.move_to_end(key)
-                return hit
-
-        def cache_put(key, value):
-            with self._match_cache_lock:
-                while len(self._match_cache) >= self._match_cache_max:
-                    self._match_cache.popitem(last=False)
-                self._match_cache[key] = value
-
+            groups.setdefault(_group_key(doc) + (ops[i],), []).append(i)
         for key, idxs in groups.items():
-            cached = cache_get(key)
+            cached = self._mcache_get(key)
             if cached is None:
                 rep = wrapped[idxs[0]]
+                rep_adm = adm3s[idxs[0]]
                 cached = np.array([
-                    self._match_one(j, rep, adm3) if simple[j] else False
+                    self._match_one(j, rep, rep_adm) if simple[j] else False
                     for j in range(p)])
-                cache_put(key, cached)
+                self._mcache_put(key, cached)
             match[idxs, :] = cached
         # label-selector rules: the decision depends only on (group,
         # labels) — cache per distinct label set (cardinality of label
@@ -367,48 +439,69 @@ class BatchScanner:
         if label_js.size:
             for i, doc in enumerate(resources):
                 labels = (doc.get('metadata') or {}).get('labels') or {}
-                lkey = (_group_key(doc), operation,
+                lkey = (_group_key(doc), ops[i],
                         tuple(sorted(labels.items())))
-                cached = cache_get(lkey)
+                cached = self._mcache_get(lkey)
                 if cached is None:
                     cached = np.array([
-                        self._match_one(int(j), wrapped[i], adm3)
+                        self._match_one(int(j), wrapped[i], adm3s[i])
                         for j in label_js])
-                    cache_put(lkey, cached)
+                    self._mcache_put(lkey, cached)
                 match[i, label_js] = cached
         # remaining non-simple rules (names, annotations, wildcard
-        # namespaces, roles): evaluate per resource
+        # namespaces, roles): evaluate per resource with that row's own
+        # admission tuple — except admission-eligible columns of device-
+        # valid rows, which the evaluator decides in-graph
         rest = ~simple & ~np.asarray(self._label_match)
+        dev_cols: Dict[int, int] = {}
+        if plan is not None and self._adm_cols is not None:
+            dev_cols = {int(j): c for c, j in enumerate(self._adm_cols)}
         for j in np.nonzero(rest)[0]:
-            for i in range(n):
-                match[i, j] = self._match_one(int(j), wrapped[i], adm3)
+            j = int(j)
+            c = dev_cols.get(j)
+            if c is not None:
+                up = plan.upper[:, c]
+                for i in range(n):
+                    match[i, j] = up[i] if plan.valid[i] else \
+                        self._match_one(j, wrapped[i], adm3s[i])
+            else:
+                for i in range(n):
+                    match[i, j] = self._match_one(j, wrapped[i], adm3s[i])
         return match
 
     def _fold_old_matches(self, match: np.ndarray,
                           wrapped: List[Resource],
-                          admission: Optional[tuple],
+                          adm_rows: Optional[List[Optional[tuple]]],
                           old_resources) -> np.ndarray:
         """UPDATE-verb match semantics folded into the sieve: the engine
         retries a failed new-object match against the old object
         (engine.py:303 ``_matches``), and a namespaced policy applies
         only when BOTH objects sit in its namespace (engine.py:239).
-        Rows are admission-sized (≤ the batch cap), so the per-(row,
-        program) host walk here is noise next to the device dispatch."""
-        adm3 = admission[:3] if admission else None
+        The old objects run through ``match_matrix`` themselves, so the
+        group cache amortizes the retry across a batch exactly like the
+        new-object sieve (the per-(row, program) host walk this
+        replaced dominated mixed-verb batches at 1k policies)."""
+        rows = [i for i, old in enumerate(old_resources) if old]
+        if not rows:
+            return match
+        old_docs = [old_resources[i] for i in rows]
+        old_wrapped = [Resource(d) for d in old_docs]
+        sub_adm = [adm_rows[i] for i in rows] if adm_rows is not None \
+            else None
+        om = self.match_matrix(old_docs, old_wrapped, adm_rows=sub_adm)
         match = match.copy()
+        ridx = np.asarray(rows)
+        match[ridx] |= om
         progs = self.cps.programs
-        for i, old in enumerate(old_resources):
-            if not old:
-                continue
-            ores = Resource(old)
-            for j in range(len(progs)):
-                if not match[i, j]:
-                    match[i, j] = self._match_one(j, ores, adm3)
-                if match[i, j]:
-                    policy = self.policies[progs[j].policy_index]
-                    if not (self._policy_gate(policy, wrapped[i]) and
-                            self._policy_gate(policy, ores)):
-                        match[i, j] = False
+        for j in range(len(progs)):
+            policy = self.policies[progs[j].policy_index]
+            if not policy.is_namespaced:
+                continue  # both-object gate is vacuous
+            for k, i in enumerate(rows):
+                if match[i, j] and not (
+                        self._policy_gate(policy, wrapped[i]) and
+                        self._policy_gate(policy, old_wrapped[k])):
+                    match[i, j] = False
         return match
 
     # -- device evaluation --------------------------------------------------
@@ -456,8 +549,12 @@ class BatchScanner:
 
     def _device_status_chunks(self, resources: List[dict],
                               contexts: Optional[List[dict]] = None,
-                              match: Optional[np.ndarray] = None):
-        """Yield ``(start, status, detail, fdet)`` per fixed-size chunk.
+                              match: Optional[np.ndarray] = None,
+                              adm_plan: Optional[Any] = None):
+        """Yield ``(start, status, detail, fdet, adm)`` per fixed-size
+        chunk; ``adm`` is the device's per-row admission-match decision
+        for the eligible program columns (None off the compact path or
+        when the policy set has none).
 
         Three-stage pipeline: an encode thread projects chunk i+2 onto the
         slot table while a dispatch thread streams chunk i+1 to the device
@@ -470,7 +567,7 @@ class BatchScanner:
         n = len(resources)
         if not self.cps.programs or not resources:
             z = np.zeros((n, len(self.cps.programs)), np.int8)
-            yield 0, z, z, z.astype(np.int32)
+            yield 0, z, z, z.astype(np.int32), None
             return
         from concurrent.futures import ThreadPoolExecutor
         from ..observability import device as devtel
@@ -572,6 +669,19 @@ class BatchScanner:
                 mm[:ln] = mm_u
                 tensors = dict(tensors)
                 tensors['__match__'] = mm
+            if self._adm is not None and self.mesh is None and tensors:
+                # admission lanes ride EVERY non-mesh dispatch of this
+                # policy set (zero-filled when the scan carries no
+                # admission data) so the executable signature — and the
+                # fresh-process census — never depends on traffic mix
+                padded = next(iter(tensors.values())).shape[0]
+                tensors = dict(tensors)
+                if adm_plan is not None:
+                    tensors.update(admission_lanes.slice_lanes(
+                        adm_plan.lanes, start, ln, padded))
+                else:
+                    tensors.update(admission_lanes.zero_lanes(
+                        self._adm, padded))
             t, layout = shard_batch(tensors, self.mesh, device=device)
             out = self._evaluator(t, layout)
             if len(out) == 2:
@@ -583,9 +693,10 @@ class BatchScanner:
                     o8 = np.array(out[0])
                     o32 = np.array(out[1])
                     g.add_d2h_bytes(o8.nbytes + o32.nbytes)
-                s, d, fd = expand_compact(o8, o32, self._evaluator)
+                s, d, fd, adm = expand_compact(o8, o32, self._evaluator)
                 self._free_inputs(t, out)
-                return s[:ln], d[:ln], fd[:ln]
+                return (s[:ln], d[:ln], fd[:ln],
+                        adm[:ln] if adm is not None else None)
             s, d, fd = out
             if self.mesh is not None:
                 import jax
@@ -605,7 +716,7 @@ class BatchScanner:
                 g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
             if self.mesh is None:
                 self._free_inputs(t, out)
-            return s, d, fd
+            return s, d, fd, None
 
         if n <= chunk:
             # single-chunk fast path: thread-pool spawn/join costs more
@@ -641,7 +752,7 @@ class BatchScanner:
                          match: Optional[np.ndarray] = None):
         parts = list(self._device_status_chunks(resources, contexts, match))
         if len(parts) == 1:
-            return parts[0][1:]
+            return parts[0][1:4]
         return tuple(np.concatenate([p[i] for p in parts])
                      for i in range(1, 4))
 
@@ -660,7 +771,8 @@ class BatchScanner:
              contexts: Optional[List[dict]] = None,
              admission: Optional[tuple] = None,
              pctx_factory=None,
-             old_resources: Optional[List[Optional[dict]]] = None
+             old_resources: Optional[List[Optional[dict]]] = None,
+             admissions: Optional[List[Optional[tuple]]] = None
              ) -> List[List[EngineResponse]]:
         """Return, per resource, the engine responses of all policies with
         at least one applicable rule (host-identical).
@@ -669,20 +781,27 @@ class BatchScanner:
         resource), ``admission`` (admission_info, exclude_group_roles,
         namespace_labels, operation) for match semantics, and
         ``pctx_factory(doc)`` so host materialization sees the same
-        PolicyContext the engine loop would build.  UPDATE-verb rows
-        additionally carry their ``oldObject`` in ``old_resources``
-        (row-aligned, None for rows without one): the engine retries a
-        failed new-object match against the old object, so the host
-        match sieve must too — evaluation itself stays on the new
-        object, exactly like the engine."""
+        PolicyContext the engine loop would build.  Heterogeneous
+        batches pass ``admissions`` — one admission tuple PER ROW —
+        instead: rules whose match depends on the tuple are decided
+        in-graph from per-row admission lanes when the policy set
+        lowered them (compiler/admission.py), per-row on the host
+        otherwise.  UPDATE-verb rows additionally carry their
+        ``oldObject`` in ``old_resources`` (row-aligned, None for rows
+        without one): the engine retries a failed new-object match
+        against the old object, so the host match sieve must too —
+        evaluation itself stays on the new object, exactly like the
+        engine."""
         return list(self.scan_stream(resources, contexts, admission,
-                                     pctx_factory, old_resources))
+                                     pctx_factory, old_resources,
+                                     admissions))
 
     def scan_stream(self, resources: List[dict],
                     contexts: Optional[List[dict]] = None,
                     admission: Optional[tuple] = None,
                     pctx_factory=None,
-                    old_resources: Optional[List[Optional[dict]]] = None):
+                    old_resources: Optional[List[Optional[dict]]] = None,
+                    admissions: Optional[List[Optional[tuple]]] = None):
         """Generator form of ``scan``: yields each resource's responses
         in order as its device chunk completes.  Consumers that do
         per-resource work (report construction, CR writes) overlap it
@@ -691,10 +810,11 @@ class BatchScanner:
         if not resources:
             return
         yield from self._scan_inner(resources, contexts, admission,
-                                    pctx_factory, old_resources)
+                                    pctx_factory, old_resources,
+                                    admissions)
 
     def _scan_inner(self, resources, contexts, admission, pctx_factory,
-                    old_resources=None):
+                    old_resources=None, admissions=None):
         n = len(resources)
         self._pctx_factory = pctx_factory
         # context-load outcomes are memoized within one scan pass only —
@@ -703,11 +823,33 @@ class BatchScanner:
         self._ctx_ok_cache = {}
         # admission scans evaluate every policy; the background gate
         # (engine.py:174 apply_background_checks) only applies to scans
-        background_mode = admission is None and pctx_factory is None
+        background_mode = admission is None and admissions is None and \
+            pctx_factory is None
         wrapped = [Resource(r) for r in resources]
-        match = self.match_matrix(resources, wrapped, admission)
+        adm_rows = admissions if admissions is not None else (
+            [admission] * n if admission is not None else None)
+        # per-row admission lanes: encode once per scan; rows whose
+        # tuples do not intern exactly fall back to the host matcher
+        # alone (taxonomy: admission_unencodable), never the batch
+        plan = None
+        if adm_rows is not None and self._adm is not None and \
+                self.mesh is None:
+            old_flags = [bool(o) for o in old_resources] \
+                if old_resources is not None else None
+            plan = admission_lanes.encode_rows(self._adm, adm_rows,
+                                               old_flags)
+            atoms = self._adm_res_atoms(resources, wrapped)
+            plan.lanes['__admres__'] = atoms
+            plan.upper = admission_lanes.match_upper(self._adm, atoms)
+            bad = int(plan.unencodable.sum())
+            if bad:
+                coverage.record_fallback(
+                    'validate', coverage.REASON_ADMISSION_UNENCODABLE,
+                    rows=bad)
+        match = self.match_matrix(resources, wrapped, adm_rows=adm_rows,
+                                  plan=plan)
         if old_resources is not None and any(old_resources):
-            match = self._fold_old_matches(match, wrapped, admission,
+            match = self._fold_old_matches(match, wrapped, adm_rows,
                                            old_resources)
         now = time.time()
         ts = int(now)
@@ -737,7 +879,8 @@ class BatchScanner:
         # current-span contextvar into the consumer and record a bogus
         # error when the consumer stops iterating early
         from ..observability import tracing
-        chunks = self._device_status_chunks(resources, contexts, match)
+        chunks = self._device_status_chunks(resources, contexts, match,
+                                            adm_plan=plan)
         tally = coverage.scan_tally()
         start = 0
         try:
@@ -747,9 +890,19 @@ class BatchScanner:
                         {'chunk_start': start,
                          'programs': len(progs)}) as span:
                     try:
-                        start, status, detail, fdet = next(chunks)
+                        start, status, detail, fdet, adm_out = \
+                            next(chunks)
                     except StopIteration:
                         return
+                    if adm_out is not None and plan is not None:
+                        # the exact in-graph admission-match decision
+                        # replaces the conservative upper bound for
+                        # device-valid rows before assembly reads it
+                        vr = np.flatnonzero(
+                            plan.valid[start:start + status.shape[0]])
+                        if vr.size:
+                            match[np.ix_(start + vr, self._adm_cols)] = \
+                                adm_out[vr].astype(bool)
                     span.set_attribute('resources', status.shape[0])
                     from ..observability import device as devtel
                     with devtel.stage('report',
@@ -954,7 +1107,7 @@ class BatchScanner:
         try:
             while start < n:
                 try:
-                    start, status, detail, fdet = next(chunks)
+                    start, status, detail, fdet, _adm = next(chunks)
                 except StopIteration:
                     return
                 m = status.shape[0]

@@ -1,0 +1,192 @@
+"""Synthetic cluster admission-traffic generator.
+
+Models the traffic shape the heterogeneous-occupancy work targets
+(ROADMAP): millions-of-users admission streams are NOT homogeneous —
+they mix distinct userInfos (zipfian: a few controllers dominate, a
+long tail of humans), many namespaces (zipfian too), CREATE/UPDATE
+verbs, a small population of exception-holding tenants whose requests
+ride the host engine loop, and bursty/trickling arrival.  The
+generator is fully deterministic for a seed, so bench numbers and
+tests reproduce.
+
+Consumers:
+
+* ``bench.py`` drives the admission-concurrency bench with
+  :meth:`SyntheticCluster.review_bytes` and ratchets mean batch
+  occupancy under this traffic (``HET_OCCUPANCY_FLOOR``);
+* tests use small instances to pin batched-vs-sync bit-identity under
+  mixed admission tuples.
+
+Layered beside the kuttl/scenario harness (this package): scenarios
+replay *recorded* cases, the generator synthesizes *load*.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def _zipf_cum(n: int, s: float) -> List[float]:
+    """Cumulative zipf(s) weights over ranks 1..n (rank 1 hottest)."""
+    total = 0.0
+    out: List[float] = []
+    for k in range(1, n + 1):
+        total += 1.0 / (k ** s)
+        out.append(total)
+    return out
+
+
+class SyntheticCluster:
+    """Deterministic admission-traffic source for one synthetic cluster.
+
+    ``request(i)`` is a pure function of ``(seed, i)``: the i-th
+    request's user, namespace, verb, and pod shape never depend on how
+    many requests were drawn before it, so threads can partition the
+    index space freely and still replay identically.
+    """
+
+    def __init__(self, seed: int = 0, users: int = 200,
+                 namespaces: int = 32, teams: int = 12,
+                 zipf_s: float = 1.1, update_ratio: float = 0.25,
+                 delete_ratio: float = 0.0,
+                 exception_tenant_ratio: float = 0.05,
+                 compliant_ratio: float = 0.5):
+        import random
+        self.seed = seed
+        self._base = random.Random(seed)
+        self.users = [f'user-{i}' for i in range(max(1, users))]
+        self.namespaces = [f'ns-{i}' for i in range(max(1, namespaces))]
+        self.teams = max(1, teams)
+        self.update_ratio = update_ratio
+        self.delete_ratio = delete_ratio
+        self.compliant_ratio = compliant_ratio
+        self._user_cum = _zipf_cum(len(self.users), zipf_s)
+        self._ns_cum = _zipf_cum(len(self.namespaces), zipf_s)
+        # a deterministic zipf-tail slice of tenants holds policy
+        # exceptions; their requests leave the batched device path
+        step = max(1, int(round(1.0 / exception_tenant_ratio))) \
+            if exception_tenant_ratio > 0 else 0
+        self.exception_users = frozenset(
+            u for i, u in enumerate(self.users)
+            if step and i % step == step - 1)
+
+    # -- per-index draws ---------------------------------------------------
+
+    def _rng(self, i: int):
+        import random
+        return random.Random((self.seed << 20) ^ i)
+
+    @staticmethod
+    def _pick(rng, items: List[str], cum: List[float]) -> str:
+        r = rng.random() * cum[-1]
+        return items[min(bisect.bisect_left(cum, r), len(items) - 1)]
+
+    def user_info(self, user: str) -> Dict:
+        idx = int(user.rsplit('-', 1)[1])
+        groups = ['system:authenticated', f'team-{idx % self.teams}']
+        if idx % 7 == 0:
+            groups.append('system:masters')
+        return {'username': user, 'groups': groups}
+
+    def is_exception_tenant(self, username: str) -> bool:
+        return username in self.exception_users
+
+    def pod(self, ns: str, name: str, user: str,
+            compliant: bool) -> Dict:
+        idx = int(user.rsplit('-', 1)[1])
+        labels = {'app': f'svc-{idx % 17}'}
+        if compliant:
+            labels['team'] = f'team-{idx % self.teams}'
+        containers = [{'name': f'c{k}', 'image': f'registry/app:{idx % 5}'}
+                      for k in range(1 + idx % 3)]
+        return {'apiVersion': 'v1', 'kind': 'Pod',
+                'metadata': {'name': name, 'namespace': ns,
+                             'labels': labels},
+                'spec': {'containers': containers}}
+
+    def request(self, i: int) -> Dict:
+        """The i-th AdmissionRequest dict (uid, operation, object,
+        oldObject for UPDATE, userInfo)."""
+        rng = self._rng(i)
+        user = self._pick(rng, self.users, self._user_cum)
+        ns = self._pick(rng, self.namespaces, self._ns_cum)
+        compliant = rng.random() < self.compliant_ratio
+        name = f'pod-{i}'
+        doc = self.pod(ns, name, user, compliant)
+        verb_draw = rng.random()
+        if verb_draw < self.delete_ratio:
+            operation = 'DELETE'
+        elif verb_draw < self.delete_ratio + self.update_ratio:
+            operation = 'UPDATE'
+        else:
+            operation = 'CREATE'
+        req = {
+            'uid': f'load-{self.seed}-{i}',
+            'operation': operation,
+            'kind': {'group': '', 'version': 'v1', 'kind': 'Pod'},
+            'namespace': ns, 'name': name,
+            'userInfo': self.user_info(user),
+        }
+        if operation == 'DELETE':
+            req['oldObject'] = doc
+        else:
+            req['object'] = doc
+            if operation == 'UPDATE':
+                old = json.loads(json.dumps(doc))
+                old['metadata']['labels'].pop('team', None)
+                old['metadata']['labels']['rev'] = 'old'
+                req['oldObject'] = old
+        return req
+
+    def review(self, i: int) -> Dict:
+        return {'apiVersion': 'admission.k8s.io/v1',
+                'kind': 'AdmissionReview', 'request': self.request(i)}
+
+    def review_bytes(self, i: int) -> bytes:
+        return json.dumps(self.review(i)).encode('utf-8')
+
+    # -- arrival schedules -------------------------------------------------
+
+    def arrivals(self, count: int, pattern: str = 'burst',
+                 burst: int = 16, gap_ms: float = 2.0,
+                 rate_per_s: float = 500.0, start: int = 0
+                 ) -> Iterator[Tuple[float, bytes]]:
+        """Yield ``(delay_before_send_s, review_bytes)`` pairs.
+
+        ``burst`` releases ``burst`` back-to-back requests then pauses
+        ``gap_ms``; ``trickle`` spaces requests exponentially around
+        ``rate_per_s``; ``steady`` is fixed spacing.  Deterministic."""
+        rng = self._rng(-1 - start)
+        for k in range(count):
+            i = start + k
+            if pattern == 'burst':
+                delay = 0.0 if (k % max(1, burst)) else (
+                    0.0 if k == 0 else gap_ms / 1000.0)
+            elif pattern == 'trickle':
+                delay = rng.expovariate(rate_per_s)
+            else:  # steady
+                delay = 1.0 / rate_per_s
+            yield delay, self.review_bytes(i)
+
+    # -- exception-holding tenants ----------------------------------------
+
+    def exception_docs(self, policy_name: str = 'loadgen-exception',
+                       rule_names: Optional[List[str]] = None
+                       ) -> List[Dict]:
+        """PolicyException documents for the exception-tenant
+        population.  With the default placeholder ``policy_name`` they
+        match no real policy: requests still pay the exception-bearing
+        host path (`pctx.exceptions` non-empty disables the device fast
+        path) without changing any verdict — the load shape, not the
+        outcome."""
+        return [{
+            'apiVersion': 'kyverno.io/v2beta1',
+            'kind': 'PolicyException',
+            'metadata': {'name': f'exc-{u}', 'namespace': 'kyverno'},
+            'spec': {'exceptions': [{
+                'policyName': policy_name,
+                'ruleNames': rule_names or ['*'],
+            }]},
+        } for u in sorted(self.exception_users)]

@@ -158,7 +158,12 @@ class Engine:
             return entry[1]
         rules = compute_rules(policy)
         if len(self._rules_cache) >= self._RULES_CACHE_MAX:
-            self._rules_cache.pop(next(iter(self._rules_cache)))
+            # webhook threads share one engine: two threads evicting at
+            # once can race next(iter)/pop — eviction is best-effort
+            try:
+                self._rules_cache.pop(next(iter(self._rules_cache)))
+            except (KeyError, StopIteration, RuntimeError):
+                pass
         self._rules_cache[key] = (policy.raw, rules)
         return rules
 

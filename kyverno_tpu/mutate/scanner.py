@@ -77,6 +77,12 @@ class MutateScanner:
         self.engine = engine or Engine()
         self.program: MutateSetProgram = compile_mutate_set(self.policies)
         self.ok = self.program.device_ok and bool(self.program.programs)
+        # serving coalesces on the scanner serial alone: the match sieve
+        # below runs per row with that row's own admission tuple, so
+        # mixed-user/mixed-verb mutate bursts share a dispatch
+        from ..compiler.scan import next_scanner_serial
+        self.serial = next_scanner_serial()
+        self.supports_row_admissions = True
         if coverage.enabled():
             coverage.record_placements(self.program.placements)
         from ..aotcache.keys import policy_set_fingerprint
@@ -116,11 +122,14 @@ class MutateScanner:
              admission: Optional[tuple] = None,
              pctx_factory=None,
              operations: Optional[List[str]] = None,
-             old_resources: Optional[List[Optional[dict]]] = None):
+             old_resources: Optional[List[Optional[dict]]] = None,
+             admissions: Optional[List[Optional[tuple]]] = None):
         """Per resource: ``(steps, patched)`` where ``steps`` is the
         ordered ``[(policy, EngineResponse), ...]`` chain the handler's
         host loop would produce (stopping after the first unsuccessful
-        policy) and ``patched`` the cumulative document.  ``contexts``/
+        policy) and ``patched`` the cumulative document.  ``admissions``
+        carries one admission tuple per row (heterogeneous batches);
+        the match sieve runs each row against its own.  ``contexts``/
         ``operations``/``old_resources`` are accepted for batcher
         signature compatibility; mutation evaluates the new object."""
         if not self.ok:
@@ -128,8 +137,10 @@ class MutateScanner:
         n = len(resources)
         if n == 0:
             return []
-        match = np.stack([self._match_row(doc, admission)
-                          for doc in resources])
+        adm_rows = admissions if admissions is not None \
+            else [admission] * n
+        match = np.stack([self._match_row(doc, adm_rows[i])
+                          for i, doc in enumerate(resources)])
         registry = global_registry()
         t0 = time.monotonic()
         with tracing.start_span('kyverno/mutate/patch_emit',

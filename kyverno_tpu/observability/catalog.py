@@ -71,6 +71,11 @@ METRICS: Dict[str, Metric] = {
         'histogram', 'Coalesced requests per shared device dispatch '
         '(flushes on the KTPU_BATCH_WINDOW_MS window or at '
         'KTPU_BATCH_MAX occupancy).'),
+    'kyverno_tpu_admission_hetero_occupancy': Metric(
+        'histogram', 'Coalesced requests per shared dispatch whose '
+        'riders carried MORE than one distinct canonical admission '
+        'tuple (heterogeneous traffic) — distinguishes real mixed-user '
+        'coalescing from same-tuple batching in production telemetry.'),
     'kyverno_tpu_admission_queue_wait_seconds': Metric(
         'histogram', 'Time a request waited in the admission queue '
         'before its batch dispatched.'),

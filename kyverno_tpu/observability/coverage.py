@@ -42,8 +42,9 @@ PLACEMENT_HOST = 'host'
 PLACEMENT_PARTIAL = 'partial'
 
 #: counter ``path`` label values (mutate covers the bulk-apply fast
-#: path; generate rules appear in placement records only)
-PATHS = ('validate', 'mutate', 'pss')
+#: path; generate rules appear in placement records only; serving
+#: covers admission-batching fallbacks decided before any scan runs)
+PATHS = ('validate', 'mutate', 'pss', 'serving')
 
 # -- fallback-reason taxonomy ------------------------------------------------
 # Compile time (whole-rule placement):
@@ -76,6 +77,13 @@ REASON_SITE_CONFLICT = 'edit_site_conflict'  # two lowered mutate rules
 REASON_PATCH_UNDECIDABLE = 'patch_undecidable'  # the encoded lanes
 #   cannot decide whether the live value equals the patch constant
 #   (numeric outside the exact milli window) — host applies instead
+# Per-row admission lanes (compiler/admission.py):
+REASON_ADMISSION_UNENCODABLE = 'admission_unencodable'  # a request's
+#   admission tuple did not intern exactly into the per-row lanes
+#   (non-string values, lane-width overflow) — that ROW's admission
+#   match runs on the host matcher; path="serving" counts batcher
+#   tickets keyed on the whole canonical tuple because their scanner
+#   cannot consume per-row admissions
 
 REASONS = frozenset({
     REASON_UNSUPPORTED_OPERATOR, REASON_HOST_CLOSURE, REASON_API_CALL,
@@ -83,6 +91,7 @@ REASONS = frozenset({
     REASON_CONTEXT_LOAD, REASON_NON_DICT, REASON_DUP_ELEMENT_NAMES,
     REASON_REPLACE_PATH_MISSING, REASON_PRECONDITION_ESCAPE,
     REASON_SITE_CONFLICT, REASON_PATCH_UNDECIDABLE,
+    REASON_ADMISSION_UNENCODABLE,
 })
 
 

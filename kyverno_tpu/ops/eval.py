@@ -1145,9 +1145,109 @@ from ..aotcache.keys import (enable_persistent_compilation_cache,  # noqa: E402,
                              policy_set_fingerprint)
 
 
+#: per-row admission lane names (compiler/admission.py contract); the
+#: lanes ride every non-mesh dispatch of a policy set with at least one
+#: admission-dependent eligible rule, zero-filled when the scan carries
+#: no admission data, so they add inputs — never executables
+ADM_LANES = ('__admres__', '__adm_user__', '__adm_groups__',
+             '__adm_roles__', '__adm_croles__', '__adm_hasinfo__',
+             '__adm_excluded__')
+
+
+def _adm_member2(lanes2d, ids):
+    """∃ lane value ∈ ids over a [R, W] id lane (ids are static interned
+    operand ids ≥ 0; -1 marks absent/out-of-vocabulary lane slots)."""
+    ops = jnp.asarray(list(ids), dtype=jnp.int32)
+    return jnp.any(lanes2d[:, :, None] == ops[None, None, :], axis=(1, 2))
+
+
+def _adm_member1(lane1d, ids):
+    ops = jnp.asarray(list(ids), dtype=jnp.int32)
+    return jnp.any(lane1d[:, None] == ops[None, :], axis=1)
+
+
+def _adm_match_graph(table, lanes):
+    """[R, n_elig] bool: the jitted half of matches_resource_description
+    for admission-eligible programs — the static filter tree
+    (compiler/admission.py AdmProgram) over host-computed resource-shape
+    atoms (``__admres__``) and the per-row user-info id lanes.  Exactly
+    mirrors engine/match.py's _check_filter / _check_user_info /
+    check_subjects semantics for the lowered vocabulary."""
+    atoms = lanes['__admres__'] != 0
+    user = lanes['__adm_user__']
+    groups = lanes['__adm_groups__']
+    roles = lanes['__adm_roles__']
+    croles = lanes['__adm_croles__']
+    hasinfo = lanes['__adm_hasinfo__'] != 0
+    excluded = lanes['__adm_excluded__'] != 0
+    false = jnp.zeros(user.shape, bool)
+
+    def ui_ok(f):
+        # excluded users skip role gates entirely, and ride the
+        # exclude-group-roles Group subjects the host matcher appends
+        ok = None
+        if f.has_roles:
+            hit = _adm_member2(roles, f.roles) if f.roles else false
+            ok = excluded | hit
+        if f.has_croles:
+            hit = _adm_member2(croles, f.cluster_roles) \
+                if f.cluster_roles else false
+            ok = (excluded | hit) if ok is None else ok & (excluded | hit)
+        if f.has_subjects:
+            hit = false
+            if f.subjects_ug:
+                # User/Group names match any of groups ∪ {username}
+                hit = hit | _adm_member2(groups, f.subjects_ug) | \
+                    _adm_member1(user, f.subjects_ug)
+            if f.subjects_sa:
+                hit = hit | _adm_member1(user, f.subjects_sa)
+            sub = hit | excluded
+            ok = sub if ok is None else ok & sub
+        return ok if ok is not None else ~false
+
+    def filter_ok(f, mode):
+        res_ok = atoms[:, f.atom]
+        if mode == 'match':
+            # without admission info the matcher drops user info: a
+            # filter reduced to nothing is 'match cannot be empty'
+            if not f.has_ui:
+                return res_ok if f.has_res else false
+            with_ui = res_ok & ui_ok(f)
+            without = res_ok if f.has_res else false
+            return jnp.where(hasinfo, with_ui, without)
+        # exclude mode: user info always applies; an empty filter
+        # never excludes (folded to 'none' at compile time)
+        if not f.has_ui and not f.has_res:
+            return false
+        ok = res_ok
+        if f.has_ui:
+            ok = ok & ui_ok(f)
+        return ok
+
+    def combine(kind, oks):
+        if kind == 'none' or not oks:
+            return false
+        acc = oks[0]
+        for o in oks[1:]:
+            acc = (acc & o) if kind == 'all' else (acc | o)
+        return acc
+
+    cols = []
+    for p in table.programs:
+        m = combine(p.match_kind,
+                    [filter_ok(f, 'match') for f in p.match_filters])
+        e = combine(p.exclude_kind,
+                    [filter_ok(f, 'exclude') for f in p.exclude_filters])
+        cols.append(m & ~e)
+    return jnp.stack(cols, axis=1)
+
 
 def build_evaluator(cps: CompiledPolicySet):
     enable_persistent_compilation_cache()
+    from ..compiler.admission import compile_admission
+    # frozen NamedTuple-of-tuples: trace-static by construction, so the
+    # jitted closure below can never drift under a cached executable
+    adm_table = compile_admission(cps)
     slot_prefix = {slot: f's{i}' for i, slot in enumerate(cps.slots)}
     gather_prefix = {g: f'g{k}' for k, g in enumerate(cps.gathers)}
     elem_prefix = {g: f'e{k}' for k, g in enumerate(cps.elem_gathers)}
@@ -1673,6 +1773,12 @@ def build_evaluator(cps: CompiledPolicySet):
         # every occupancy with bit-identical output.
         rowvalid = t.pop('__rowvalid__', None)
         match = t.pop('__match__', None)
+        adm_in = {name: t.pop(name) for name in ADM_LANES if name in t}
+        if not t and rowvalid is not None:
+            # slot-free policy sets (e.g. pure deny-by-subject rules —
+            # exactly the admission-lane vocabulary) still need one
+            # reference array for constant-tree row shapes
+            t = {'__rowref__': rowvalid}
         if match is None:
             return evaluate(t)
         # compact form, all in UNIQUE space (match arrives pre-folded to
@@ -1700,6 +1806,14 @@ def build_evaluator(cps: CompiledPolicySet):
             fdet_u, jnp.minimum(order, c - 1).astype(jnp.int32), axis=1)
         out32 = jnp.concatenate([order, fds.astype(jnp.int32)], axis=1)
         out8 = jnp.concatenate([s_u, d_u], axis=1)
+        if adm_table is not None and len(adm_in) == len(ADM_LANES):
+            # per-row admission match for eligible programs, decided
+            # in-graph and shipped back as extra int8 columns (the host
+            # replaces its conservative match upper bound with these
+            # before assembly; rows the encoder marked non-valid are
+            # ignored there)
+            adm = _adm_match_graph(adm_table, adm_in).astype(jnp.int8)
+            out8 = jnp.concatenate([out8, adm], axis=1)
         return out8, out32
 
     jitted = jax.jit(evaluate_packed)
@@ -1827,6 +1941,10 @@ def build_evaluator(cps: CompiledPolicySet):
     call.expand_idx = expand_idx_np
     call.expand_identity = expand_identity
     call.uniq_groups = uniq_groups
+    call.adm_table = adm_table
+    call.n_adm = len(adm_table.programs) if adm_table is not None else 0
+    call.adm_cols = adm_table.program_cols() if adm_table is not None \
+        else np.zeros(0, np.int64)
     return call
 
 
@@ -1856,13 +1974,19 @@ def fold_match_unique(mm: np.ndarray, evaluator) -> np.ndarray:
 
 
 def expand_compact(out8: np.ndarray, out32: np.ndarray, evaluator):
-    """Reconstruct program-space (statuses, details, dense fdet) from the
-    unique-space compact device outputs.  Cells beyond the per-row
-    budget stay -1, which downstream message synthesis treats as
-    'materialize on host' — exactness is never lost."""
-    n_uniq = out8.shape[1] // 2
+    """Reconstruct program-space (statuses, details, dense fdet,
+    admission-match) from the unique-space compact device outputs.
+    Cells beyond the per-row budget stay -1, which downstream message
+    synthesis treats as 'materialize on host' — exactness is never
+    lost.  The trailing admission columns (None when the policy set has
+    no admission-eligible rules) are the in-graph per-row match
+    decisions for ``evaluator.adm_cols``."""
+    n_adm = getattr(evaluator, 'n_adm', 0)
+    width = out8.shape[1] - n_adm
+    n_uniq = width // 2
     s_u = out8[:, :n_uniq]
     d_u = out8[:, n_uniq:n_uniq * 2]
+    adm = out8[:, width:] if n_adm else None
     k = out32.shape[1] // 2
     cols = out32[:, :k]
     fds = out32[:, k:]
@@ -1870,9 +1994,10 @@ def expand_compact(out8: np.ndarray, out32: np.ndarray, evaluator):
     rr, kk = np.nonzero(cols < evaluator.n_cols_u)
     dense_u[rr, cols[rr, kk]] = fds[rr, kk]
     if evaluator.expand_identity:
-        return s_u, d_u, dense_u
+        return s_u, d_u, dense_u, adm
     pid = evaluator.uniq_idx
-    return (s_u[:, pid], d_u[:, pid], dense_u[:, evaluator.expand_idx])
+    return (s_u[:, pid], d_u[:, pid], dense_u[:, evaluator.expand_idx],
+            adm)
 
 
 def enable_x64():

@@ -8,6 +8,17 @@ capacity, ``compiler/shapes.py``), then dispatches all claimed tickets
 of that key as ONE ``scanner.scan`` call and resolves their futures
 row by row.
 
+The coalescing key is the SCANNER ALONE (its monotonic serial): the
+scanner threads each rider's admission tuple through the compiled
+pipeline as per-row lanes (``compiler/admission.py``), so mixed-user,
+mixed-role, mixed-verb bursts — the shape of real cluster traffic —
+share one dispatch instead of degenerating to batch-of-one.  Scanners
+without per-row admission support (``supports_row_admissions`` unset)
+ride a residual key that appends the canonical admission tuple; every
+such ticket is recorded on the coverage ledger
+(``admission_unencodable``, path ``serving``) so the serialization is
+never silent.
+
 Batches are ragged: the scanner pads every dispatch to a canonical
 capacity and the evaluator masks the tail rows in-graph, so a flush at
 ANY occupancy reuses an already-compiled executable — there is no
@@ -36,13 +47,14 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
-from ..observability import tracing
+from ..observability import coverage, tracing
 from ..observability.metrics import MetricsRegistry, global_registry
 from . import shed as shed_policy
 from .queue import RequestQueue, Ticket
 
 QUEUE_DEPTH = 'kyverno_tpu_admission_queue_depth'
 BATCH_OCCUPANCY = 'kyverno_tpu_admission_batch_occupancy'
+HETERO_OCCUPANCY = 'kyverno_tpu_admission_hetero_occupancy'
 QUEUE_WAIT = 'kyverno_tpu_admission_queue_wait_seconds'
 
 #: occupancy counts requests per dispatch — power-of-two buckets up to
@@ -54,13 +66,33 @@ WAIT_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0)
 
 
+def _canon(v):
+    """Order-canonical view of one admission-tuple element: dict keys
+    sort via json, and list/tuple values sort by their JSON form —
+    roles/groups are membership sets for match semantics, so two
+    requests differing only in list order must produce ONE key."""
+    if isinstance(v, dict):
+        return {str(k): _canon(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        items = [_canon(x) for x in v]
+        try:
+            return sorted(items, key=lambda x: json.dumps(
+                x, sort_keys=True, default=str))
+        except Exception:  # noqa: BLE001 - unsortable: keep order
+            return items
+    return v
+
+
 def admission_key(admission: tuple) -> str:
-    """Canonical string of the (admission_info, exclude_group_roles,
-    namespace_labels, operation) tuple.  Requests may only share a
-    dispatch when this matches byte-for-byte: match/exclude semantics
-    (roles, subjects, namespaceSelector) depend on these values, and
-    bit-identity with the sync path is the contract."""
-    return json.dumps(admission, sort_keys=True, default=str,
+    """Deterministic canonical string of the (admission_info,
+    exclude_group_roles, namespace_labels, operation) tuple — JSON with
+    sorted keys AND sorted scalar lists, positional at the top level.
+    Used only by the residual fallback path (scanners without per-row
+    admission lanes): such requests may only share a dispatch when this
+    matches, and every use is recorded on the coverage ledger."""
+    parts = [_canon(x) for x in admission] \
+        if isinstance(admission, (list, tuple)) else _canon(admission)
+    return json.dumps(parts, sort_keys=True, default=str,
                       separators=(',', ':'))
 
 
@@ -105,8 +137,10 @@ class AdmissionBatcher:
         self.on_failure = on_failure
         self._stats_lock = threading.Lock()
         self._occupancies: deque = deque(maxlen=4096)
+        self._hetero_occupancies: deque = deque(maxlen=4096)
         self._waits_s: deque = deque(maxlen=8192)
         self._dispatches = 0
+        self._hetero_dispatches = 0
         self._requests = 0
         self._registered_on: Optional[MetricsRegistry] = None
         self._stopped = False
@@ -121,13 +155,24 @@ class AdmissionBatcher:
                old_resource: Optional[dict] = None) -> Ticket:
         """Enqueue one request; raises QueueFull / Stopped (callers shed
         to the host loop).  The current span rides along so the batch
-        span nests under the request's HTTP-handler span.  The key
-        includes the scanner identity, so validate and mutate tickets —
-        and distinct verbs, via the admission tuple's operation — never
-        share a dispatch; UPDATE tickets carry their oldObject for the
-        scanner's old-match retry."""
+        span nests under the request's HTTP-handler span.  The key is
+        the scanner's monotonic serial alone (validate and mutate
+        compile distinct scanners, so program kinds never mix, while
+        distinct users/roles/namespaces/verbs coalesce — the scanner
+        consumes per-row admission tuples); scanners without per-row
+        support fall back to serial + the canonical admission tuple,
+        recorded on the coverage ledger.  UPDATE tickets carry their
+        oldObject for the scanner's old-match retry."""
+        serial = getattr(scanner, 'serial', None)
+        sid = serial if serial is not None else id(scanner)
+        if getattr(scanner, 'supports_row_admissions', False):
+            key: tuple = ('s', sid)
+        else:
+            key = ('a', sid, admission_key(admission))
+            coverage.record_fallback(
+                'serving', coverage.REASON_ADMISSION_UNENCODABLE)
         ticket = Ticket(
-            key=(id(scanner), admission_key(admission)),
+            key=key,
             resource=resource, context=context, pctx=pctx,
             admission=admission, scanner=scanner, policies=policies,
             span=tracing.current_span(), on_shed=self.sheds.record,
@@ -181,6 +226,11 @@ class AdmissionBatcher:
         extra = {}
         if any(t.old_resource for t in batch):
             extra['old_resources'] = [t.old_resource for t in batch]
+        # heterogeneous batches: each rider's own admission tuple rides
+        # to the scanner as a per-row column (the scanner-only batch
+        # key makes mixed tuples share this dispatch)
+        if getattr(scanner, 'supports_row_admissions', False):
+            extra['admissions'] = [t.admission for t in batch]
         try:
             with devtel.install_capture(cap), \
                     tracing.tracer().start_span(
@@ -233,6 +283,8 @@ class AdmissionBatcher:
             # calls are no-ops once each histogram exists
             registry.register_histogram(BATCH_OCCUPANCY,
                                         OCCUPANCY_BUCKETS)
+            registry.register_histogram(HETERO_OCCUPANCY,
+                                        OCCUPANCY_BUCKETS)
             registry.register_histogram(QUEUE_WAIT, WAIT_BUCKETS)
             self._registered_on = registry
         return registry
@@ -244,14 +296,24 @@ class AdmissionBatcher:
 
     def _observe(self, batch, t0: float) -> None:
         waits = [t0 - t.enqueued_at for t in batch]
+        # heterogeneous = the riders carry >1 distinct canonical
+        # admission tuple; production telemetry must distinguish this
+        # coalescing regime from same-tuple (homogeneous) batching
+        hetero = len(batch) > 1 and \
+            len({admission_key(t.admission) for t in batch}) > 1
         with self._stats_lock:
             self._dispatches += 1
             self._requests += len(batch)
             self._occupancies.append(len(batch))
+            if hetero:
+                self._hetero_dispatches += 1
+                self._hetero_occupancies.append(len(batch))
             self._waits_s.extend(waits)
         registry = self._registry()
         if registry is not None:
             registry.observe(BATCH_OCCUPANCY, float(len(batch)))
+            if hetero:
+                registry.observe(HETERO_OCCUPANCY, float(len(batch)))
             for w in waits:
                 registry.observe(QUEUE_WAIT, w)
 
@@ -264,14 +326,19 @@ class AdmissionBatcher:
         """Local counters for benchmarks/tests (no registry needed)."""
         with self._stats_lock:
             occ = list(self._occupancies)
+            hocc = list(self._hetero_occupancies)
             waits = list(self._waits_s)
             dispatches = self._dispatches
+            hetero = self._hetero_dispatches
             requests = self._requests
         return {
             'dispatches': dispatches,
             'requests': requests,
             'occupancy_mean': (sum(occ) / len(occ)) if occ else 0.0,
             'occupancy_p50': self._p50(occ),
+            'hetero_dispatches': hetero,
+            'hetero_occupancy_mean': (sum(hocc) / len(hocc))
+            if hocc else 0.0,
             'queue_wait_p50_ms': self._p50(waits) * 1000.0,
             'shed_total': self.sheds.total(),
             'shed': self.sheds.counts(),
@@ -281,8 +348,10 @@ class AdmissionBatcher:
     def reset_stats(self) -> None:
         with self._stats_lock:
             self._occupancies.clear()
+            self._hetero_occupancies.clear()
             self._waits_s.clear()
             self._dispatches = 0
+            self._hetero_dispatches = 0
             self._requests = 0
         self.sheds.reset()
 

@@ -41,12 +41,15 @@ class Ticket:
     """One queued admission scan: inputs + the future its webhook
     thread blocks on.
 
-    ``key`` groups coalescible requests — same compiled scanner AND the
-    same admission tuple (userInfo / roles / namespace labels /
-    operation), so a shared dispatch is bit-identical to each request's
-    own sync scan.  ``on_shed`` is the batcher's shed ledger; the
-    deadline shed is recorded here because the waiting thread, not the
-    batcher, makes that decision.
+    ``key`` groups coalescible requests — the compiled scanner's
+    monotonic serial alone for scanners that consume per-row admission
+    tuples (mixed users/roles/namespaces/verbs share one dispatch,
+    bit-identical to each request's own sync scan because the scanner
+    threads each row's tuple through the match pipeline), or serial +
+    the canonical admission tuple on the residual path for scanners
+    without per-row support.  ``on_shed`` is the batcher's shed ledger;
+    the deadline shed is recorded here because the waiting thread, not
+    the batcher, makes that decision.
     """
 
     __slots__ = ('key', 'resource', 'context', 'pctx', 'admission',

@@ -497,11 +497,13 @@ class ResourceHandlers:
                       resource: Optional[dict] = None):
         """Route one validate or mutate scan through the micro-batcher.
 
-        The ticket key derives from the scanner identity plus the
-        admission tuple (whose 4th element is the verb), so CREATE and
-        UPDATE requests each coalesce with their own kind — the batch
-        key no longer excludes verbs — and validate/mutate dispatches
-        never mix.  Returns ``(responses, prov)``: this request's result
+        The ticket key is the scanner's monotonic serial alone:
+        validate and mutate compile distinct scanners so those
+        dispatches never mix, while distinct users, roles, namespaces
+        AND verbs coalesce — each rider's admission tuple rides to the
+        scanner as a per-row column (compiler/admission.py), so a
+        shared dispatch stays bit-identical to every request's own
+        sync scan.  Returns ``(responses, prov)``: this request's result
         rows (None when the request shed to the host engine loop —
         queue full, deadline blown, dispatch failed, or batcher stopped
         — the caller then serves the identical-verdict host path, never

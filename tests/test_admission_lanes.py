@@ -1,0 +1,394 @@
+"""Per-row admission lanes (compiler/admission.py + ops/eval.py).
+
+Pins the heterogeneous-batching contract: for admission-dependent
+rules in the lane vocabulary the jitted evaluator decides
+subject/role match in-graph from per-row lanes, bit-identical to the
+host matcher (the oracle, reachable via ``KTPU_ADM_LANES=0``); rows
+whose admission tuples do not intern exactly fall back per-row under
+the ``admission_unencodable`` taxonomy reason; and the lanes never add
+an XLA input signature (executable census stays at the canonical
+capacities).  CPU-only, tier-1.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.compiler import admission as admlanes
+from kyverno_tpu.compiler.compile import compile_policies
+from kyverno_tpu.compiler.scan import BatchScanner, next_scanner_serial
+from kyverno_tpu.engine.api import PolicyContext
+from kyverno_tpu.engine.engine import Engine
+from kyverno_tpu.observability import coverage
+
+POLICIES = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata: {labels: {team: "?*"}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: admins-only-privileged
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: admins-only
+      match:
+        any:
+          - resources: {kinds: [Pod]}
+            subjects:
+              - {kind: Group, name: system:masters}
+              - {kind: User, name: alice}
+              - {kind: ServiceAccount, name: deployer, namespace: ci}
+      validate: {message: "privileged path is admin-only", deny: {}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: exempt-bots
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: exempt-bots
+      match: {any: [{resources: {kinds: [Pod]}, clusterRoles: [bot-role]}]}
+      exclude: {any: [{subjects: [{kind: Group, name: trusted-bots}]}]}
+      validate: {message: "bots must be trusted", deny: {}}
+---
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: roles-gate
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: roles-gate
+      match:
+        all:
+          - resources: {kinds: [Pod]}
+            roles: [ns-admin]
+      validate: {message: "role-gated", deny: {}}
+"""
+
+#: a userinfo rule with a label selector is OUTSIDE the lane
+#: vocabulary (selector + roles) — must stay on the host matcher
+INELIGIBLE = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: selector-and-roles
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: selector-and-roles
+      match:
+        any:
+          - resources:
+              kinds: [Pod]
+              selector: {matchLabels: {tier: web}}
+            roles: [ops]
+      validate: {message: "selector+roles", deny: {}}
+"""
+
+
+def pod(name, labels=None, ns='default'):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': ns,
+                         'labels': labels or {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def adm(username, groups=(), roles=(), croles=(), egr=(), op='CREATE'):
+    info = {'roles': list(roles), 'clusterRoles': list(croles),
+            'userInfo': {'username': username, 'groups': list(groups)}}
+    return (info, list(egr), {}, op)
+
+
+ADMISSIONS = [
+    adm('alice'),                                       # User subject
+    adm('bob', groups=['system:masters']),              # Group subject
+    adm('carol', groups=['dev']),                       # no admin hit
+    adm('system:serviceaccount:ci:deployer'),           # SA subject
+    adm('robo', croles=['bot-role']),                   # croles, untrusted
+    adm('robo2', groups=['trusted-bots'],
+        croles=['bot-role']),                           # excluded by block
+    adm('dana', roles=['ns-admin']),                    # roles gate
+    adm('edith', groups=['dev'], croles=['bot-role'],
+        egr=['dev']),                                   # excluded groups
+    adm('frank', groups=['x' * 80]),                    # out-of-vocab key
+]
+
+
+def host_oracle(policies, doc, a):
+    engine = Engine()
+    out = []
+    for p in policies:
+        pctx = PolicyContext(p, new_resource=doc,
+                             admission_info=a[0],
+                             exclude_group_roles=a[1],
+                             admission_operation=a[3])
+        out.append(engine.validate(pctx))
+    return out
+
+
+def sig(resps):
+    """Comparable rule signature, empty responses dropped (the scanner
+    contract: policies with at least one applicable rule)."""
+    return [[(rr.name, str(rr.status), rr.message)
+             for rr in er.policy_response.rules] for er in resps
+            if er.policy_response.rules]
+
+
+@pytest.fixture(scope='module')
+def policies():
+    return [Policy(d) for d in yaml.safe_load_all(POLICIES) if d]
+
+
+@pytest.fixture(scope='module')
+def scanner(policies):
+    return BatchScanner(policies)
+
+
+class TestCompileAdmission:
+    def test_eligible_programs_lowered(self, policies):
+        cps = compile_policies(policies)
+        table = admlanes.compile_admission(cps)
+        assert table is not None
+        names = {cps.programs[p.j].rule_name for p in table.programs}
+        # require-team has no userinfo: admission-invariant, not lowered
+        assert names == {'admins-only', 'exempt-bots', 'roles-gate'}
+        # exact interning, no hashes
+        assert set(table.vocab) == {
+            'system:masters', 'alice',
+            'system:serviceaccount:ci:deployer', 'bot-role',
+            'trusted-bots', 'ns-admin'}
+
+    def test_selector_plus_roles_stays_on_host(self):
+        pols = [Policy(d) for d in yaml.safe_load_all(INELIGIBLE) if d]
+        table = admlanes.compile_admission(compile_policies(pols))
+        assert table is None
+
+    def test_admission_invariant_set_has_no_table(self):
+        pols = [Policy(d) for d in yaml.safe_load_all(POLICIES) if d][:1]
+        assert admlanes.compile_admission(compile_policies(pols)) is None
+
+    def test_knob_disables(self, policies, monkeypatch):
+        monkeypatch.setenv('KTPU_ADM_LANES', '0')
+        assert admlanes.compile_admission(
+            compile_policies(policies)) is None
+
+
+class TestRowEncoding:
+    def _table(self, policies):
+        return admlanes.compile_admission(compile_policies(policies))
+
+    def test_exact_interning_and_flags(self, policies):
+        table = self._table(policies)
+        plan = admlanes.encode_rows(table, ADMISSIONS)
+        assert plan.valid.all() and not plan.unencodable.any()
+        v = table.vocab
+        # alice (row 0): username interned, groups empty
+        assert plan.lanes['__adm_user__'][0] == v['alice']
+        # bob (row 1): system:masters group id present
+        assert v['system:masters'] in set(
+            plan.lanes['__adm_groups__'][1].tolist())
+        # frank (row 8): out-of-vocabulary values intern to -1
+        assert plan.lanes['__adm_user__'][8] == -1
+        assert (plan.lanes['__adm_groups__'][8] == -1).all()
+        # edith (row 7) is in her own exclude_group_roles
+        assert plan.lanes['__adm_excluded__'][7] == 1
+        assert plan.lanes['__adm_excluded__'][0] == 0
+
+    def test_unencodable_rows(self, policies):
+        table = self._table(policies)
+        rows = [adm('u'), adm('u', groups=[1]),          # non-str group
+                ('not-a-tuple',),                        # malformed
+                adm('u', roles=[None])]                  # non-str role
+        plan = admlanes.encode_rows(table, rows)
+        assert plan.valid.tolist() == [True, False, False, False]
+        assert plan.unencodable.tolist() == [False, True, True, True]
+
+    def test_old_rows_excluded_without_taxonomy(self, policies):
+        table = self._table(policies)
+        plan = admlanes.encode_rows(table, [adm('a'), adm('b')],
+                                    old_flags=[False, True])
+        assert plan.valid.tolist() == [True, False]
+        assert not plan.unencodable.any()
+
+    def test_lane_width_overflow_is_unencodable(self, policies):
+        table = self._table(policies)
+        # more IN-VOCABULARY ids than the lane holds is impossible with
+        # this vocab (6 entries < width); simulate via monkey vocab
+        big = admlanes.AdmissionTable(
+            table.programs, table.atoms,
+            {f'g{i}': i for i in range(admlanes.GROUPS_W + 4)})
+        row = adm('u', groups=[f'g{i}'
+                               for i in range(admlanes.GROUPS_W + 1)])
+        plan = admlanes.encode_rows(big, [row])
+        assert plan.unencodable.tolist() == [True]
+
+
+class TestBitIdentity:
+    def _scan(self, scanner, policies, resources, admissions):
+        pctxs = {
+            id(doc): PolicyContext(policies[0], new_resource=doc,
+                                   admission_info=a[0],
+                                   exclude_group_roles=a[1],
+                                   admission_operation=a[3])
+            for doc, a in zip(resources, admissions)}
+        return scanner.scan(
+            resources,
+            contexts=[{'request': {'object': d}} for d in resources],
+            admissions=admissions,
+            pctx_factory=lambda doc: pctxs[id(doc)])
+
+    def test_mixed_rows_match_host_oracle(self, scanner, policies):
+        resources = [pod(f'p{i}', {'team': 'x'} if i % 2 else {})
+                     for i in range(len(ADMISSIONS))]
+        rows = self._scan(scanner, policies, resources, ADMISSIONS)
+        for i, (doc, a) in enumerate(zip(resources, ADMISSIONS)):
+            assert sig(rows[i]) == sig(host_oracle(policies, doc, a)), i
+
+    def test_unencodable_row_still_exact(self, scanner, policies):
+        admissions = [adm('ok-user'), adm('weird', groups=[42])]
+        resources = [pod('p0'), pod('p1')]
+        rows = self._scan(scanner, policies, resources, admissions)
+        for i, (doc, a) in enumerate(zip(resources, admissions)):
+            assert sig(rows[i]) == sig(host_oracle(policies, doc, a)), i
+
+    def test_per_row_equals_row_at_a_time(self, scanner, policies):
+        resources = [pod(f'q{i}') for i in range(len(ADMISSIONS))]
+        batched = self._scan(scanner, policies, resources, ADMISSIONS)
+        for i, (doc, a) in enumerate(zip(resources, ADMISSIONS)):
+            [single] = self._scan(scanner, policies, [doc], [a])
+            assert sig(batched[i]) == sig(single), i
+
+    def test_lanes_off_is_bit_identical(self, scanner, policies,
+                                        monkeypatch):
+        resources = [pod(f'r{i}', {'team': 't'})
+                     for i in range(len(ADMISSIONS))]
+        on = self._scan(scanner, policies, resources, ADMISSIONS)
+        monkeypatch.setenv('KTPU_ADM_LANES', '0')
+        off_scanner = BatchScanner(policies)
+        assert off_scanner._adm is None
+        off = self._scan(off_scanner, policies, resources, ADMISSIONS)
+        assert [sig(a) for a in on] == [sig(b) for b in off]
+
+    def test_background_scan_unaffected(self, scanner, policies):
+        resources = [pod('bg0'), pod('bg1', {'team': 'x'})]
+        rows = scanner.scan(resources)
+        engine = Engine()
+        for i, doc in enumerate(resources):
+            want = [engine.apply_background_checks(
+                PolicyContext(p, new_resource=doc)) for p in policies]
+            assert sig(rows[i]) == sig(want)
+
+
+class TestLedgerAndShapes:
+    def test_unencodable_rows_hit_taxonomy(self, scanner, policies):
+        from kyverno_tpu.observability.metrics import MetricsRegistry
+        ledger = coverage.configure(MetricsRegistry())
+        try:
+            admissions = [adm('fine'), adm('bad', groups=[3]),
+                          adm('bad2', croles=[object()])]
+            resources = [pod(f'x{i}') for i in range(3)]
+            pctxs = {id(d): PolicyContext(policies[0], new_resource=d)
+                     for d in resources}
+            scanner.scan(resources,
+                         contexts=[{'request': {'object': d}}
+                                   for d in resources],
+                         admissions=admissions,
+                         pctx_factory=lambda doc: pctxs[id(doc)])
+            fallbacks = ledger.report()['fallbacks']
+            assert fallbacks.get('validate', {}).get(
+                coverage.REASON_ADMISSION_UNENCODABLE) == 2
+        finally:
+            coverage.disable()
+
+    def test_reason_is_in_taxonomy(self):
+        assert coverage.REASON_ADMISSION_UNENCODABLE in coverage.REASONS
+
+    def test_lanes_add_no_input_signatures(self, policies):
+        """Occupancies 1..N, mixed users, AND a no-admission background
+        scan must reuse the canonical-capacity signatures — admission
+        lanes ride every dispatch (zero-filled when absent), so the
+        executable census cannot depend on traffic mix."""
+        from kyverno_tpu.compiler import aot
+        scanner = BatchScanner(policies)
+        seen = set()
+        orig = aot.executable_cache_key
+
+        def spy(fingerprint, packed, extra=()):
+            seen.add(tuple((n, str(v.dtype), tuple(v.shape))
+                           for n, v in sorted(packed.items())))
+            return orig(fingerprint, packed, extra)
+
+        aot.executable_cache_key = spy
+        try:
+            for occ in (1, 3, 7):
+                docs = [pod(f's{occ}-{i}') for i in range(occ)]
+                admissions = [adm(f'user-{occ}-{i}')
+                              for i in range(occ)]
+                pctxs = {id(d): PolicyContext(policies[0],
+                                              new_resource=d)
+                         for d in docs}
+                scanner.scan(docs,
+                             contexts=[{'request': {'object': d}}
+                                       for d in docs],
+                             admissions=admissions,
+                             pctx_factory=lambda doc: pctxs[id(doc)])
+            scanner.scan([pod('census-bg')])
+        finally:
+            aot.executable_cache_key = orig
+        from kyverno_tpu.compiler.shapes import canonical_caps
+        assert len(seen) <= len(canonical_caps())
+
+    def test_scanner_serials_are_monotonic(self, policies):
+        a = next_scanner_serial()
+        b = next_scanner_serial()
+        assert b > a
+        s1 = BatchScanner(policies[:1])
+        s2 = BatchScanner(policies[:1])
+        assert s2.serial > s1.serial
+        assert s1.supports_row_admissions
+
+
+class TestAdmissionKeyCanonicalization:
+    def test_list_order_is_canonicalized(self):
+        from kyverno_tpu.serving.batcher import admission_key
+        a = adm('u', groups=['b', 'a'], roles=['r2', 'r1'])
+        b = adm('u', groups=['a', 'b'], roles=['r1', 'r2'])
+        assert admission_key(a) == admission_key(b)
+
+    def test_top_level_positions_are_preserved(self):
+        from kyverno_tpu.serving.batcher import admission_key
+        create = adm('u', op='CREATE')
+        update = adm('u', op='UPDATE')
+        assert admission_key(create) != admission_key(update)
+        other_user = adm('v')
+        assert admission_key(adm('u')) != admission_key(other_user)
+
+    def test_deterministic_json(self):
+        from kyverno_tpu.serving.batcher import admission_key
+        key = admission_key(adm('u', groups=['g']))
+        import json
+        assert json.loads(key)  # stable, parseable JSON
+        assert admission_key(adm('u', groups=['g'])) == key

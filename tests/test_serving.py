@@ -51,7 +51,7 @@ def pod(labels, name):
             'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
 
 
-def review_bytes(resource, uid):
+def review_bytes(resource, uid, user_info=None):
     return json.dumps({
         'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
         'request': {
@@ -60,7 +60,7 @@ def review_bytes(resource, uid):
             'namespace': 'default',
             'name': resource['metadata']['name'],
             'object': resource,
-            'userInfo': {'username': 'alice', 'groups': []},
+            'userInfo': user_info or {'username': 'alice', 'groups': []},
         }}).encode()
 
 
@@ -253,6 +253,10 @@ class TestBatchedServing:
 
 
 class _FakeScanner:
+    """Scanner WITHOUT per-row admission support: the batcher must key
+    its tickets on (serial, canonical admission tuple) — the residual
+    fallback path."""
+
     def __init__(self, fail=False):
         self.fail = fail
         self.calls = []
@@ -263,6 +267,24 @@ class _FakeScanner:
         if self.fail:
             raise RuntimeError('device gone')
         return [[('row', r['metadata']['name'])] for r in resources]
+
+
+class _RowAdmScanner(_FakeScanner):
+    """Scanner WITH per-row admission support: the batcher keys on the
+    serial alone and threads each rider's tuple through ``admissions``."""
+
+    def __init__(self):
+        super().__init__()
+        from kyverno_tpu.compiler.scan import next_scanner_serial
+        self.serial = next_scanner_serial()
+        self.supports_row_admissions = True
+        self.seen_admissions = []
+
+    def scan(self, resources, contexts=None, admission=None,
+             pctx_factory=None, admissions=None, old_resources=None):
+        self.seen_admissions.append(admissions)
+        return super().scan(resources, contexts, admission,
+                            pctx_factory)
 
 
 def _submit(batcher, scanner, name, policies=('pol',)):
@@ -307,7 +329,10 @@ class TestBatcherUnit:
         finally:
             batcher.stop(drain=False)
 
-    def test_distinct_admission_tuples_never_share_a_dispatch(self):
+    def test_residual_scanner_keeps_per_tuple_isolation(self):
+        """A scanner without per-row admission support must never mix
+        distinct admission tuples in one dispatch (the residual key
+        appends the canonical tuple)."""
         batcher = AdmissionBatcher(window_ms=30, queue_cap=64)
         try:
             scanner = _FakeScanner()
@@ -324,6 +349,63 @@ class TestBatcherUnit:
             assert t1.wait(5.0) is not None
             assert t2.wait(5.0) is not None
             assert scanner.calls == [1, 1]
+        finally:
+            batcher.stop(drain=False)
+
+    def test_row_admission_scanner_coalesces_distinct_tuples(self):
+        """The tentpole contract: with per-row admission support the
+        batch key is the scanner serial alone — distinct users share
+        ONE dispatch and each rider's tuple rides as a row."""
+        batcher = AdmissionBatcher(window_ms=60_000, max_batch=2,
+                                   queue_cap=64)
+        try:
+            scanner = _RowAdmScanner()
+            adm_a = ({'userInfo': {'username': 'alice'}}, [], {},
+                     'CREATE')
+            adm_b = ({'userInfo': {'username': 'bob'}}, [], {},
+                     'UPDATE')
+            t1 = batcher.submit(resource=pod({}, 'a'), context=None,
+                                pctx=None, admission=adm_a,
+                                scanner=scanner, policies=['pol'])
+            t2 = batcher.submit(resource=pod({}, 'b'), context=None,
+                                pctx=None, admission=adm_b,
+                                scanner=scanner, policies=['pol'])
+            assert t1.wait(5.0) is not None
+            assert t2.wait(5.0) is not None
+            # the huge window proves only the occupancy cap (2) could
+            # have flushed: both tuples rode one dispatch
+            assert scanner.calls == [2]
+            assert scanner.seen_admissions == [[adm_a, adm_b]]
+            stats = batcher.stats()
+            assert stats['hetero_dispatches'] == 1
+            assert stats['hetero_occupancy_mean'] == 2.0
+        finally:
+            batcher.stop(drain=False)
+
+    def test_canonical_admission_key_coalesces_reordered_lists(self):
+        """Equivalent tuples differing only in list order produce one
+        residual key (deterministic canonicalization)."""
+        batcher = AdmissionBatcher(window_ms=60_000, max_batch=2,
+                                   queue_cap=64)
+        try:
+            scanner = _FakeScanner()  # residual path
+            base = {'userInfo': {'username': 'u',
+                                 'groups': ['a', 'b']}, 'roles': ['r1',
+                                                                  'r2']}
+            flip = {'userInfo': {'username': 'u',
+                                 'groups': ['b', 'a']}, 'roles': ['r2',
+                                                                  'r1']}
+            t1 = batcher.submit(resource=pod({}, 'a'), context=None,
+                                pctx=None,
+                                admission=(base, [], {}, 'CREATE'),
+                                scanner=scanner, policies=['pol'])
+            t2 = batcher.submit(resource=pod({}, 'b'), context=None,
+                                pctx=None,
+                                admission=(flip, [], {}, 'CREATE'),
+                                scanner=scanner, policies=['pol'])
+            assert t1.wait(5.0) is not None
+            assert t2.wait(5.0) is not None
+            assert scanner.calls == [2]
         finally:
             batcher.stop(drain=False)
 
@@ -679,3 +761,168 @@ class TestFullVerbBatching:
         finally:
             handlers.serving_mode = prior_mode
             handlers.mutate_device = prior_mut
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous-traffic batching (PR 10): the batch key is the policy
+# set alone — N threads with DISTINCT users/groups/roles + mixed verbs
+# coalesce into shared dispatches, each response pinned identical to
+# that request's own sync scan (and to the pure host engine loop).
+
+ADMIN_GATE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: admins-only-hetero
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: admins-only
+      match:
+        any:
+          - resources: {kinds: [Pod]}
+            subjects:
+              - {kind: Group, name: system:masters}
+              - {kind: User, name: root-user}
+      validate:
+        message: "admin-gated pods need a ticket label"
+        pattern:
+          metadata: {labels: {ticket: "?*"}}
+"""
+
+
+@pytest.fixture(scope='module')
+def hetero_chain():
+    """Plain + subject-gated validate policies on one batch-mode chain:
+    the subject rule's match depends on each request's userInfo, so
+    correctness under coalescing requires the per-row admission lanes."""
+    docs = list(yaml.safe_load_all(ENFORCE_POLICY)) + \
+        list(yaml.safe_load_all(ADMIN_GATE_POLICY))
+    cache = Cache()
+    cache.warm_up([Policy(d) for d in docs if d])
+    handlers = ResourceHandlers(cache, configuration=Configuration(),
+                                serving_mode='batch')
+    server = WebhookServer(handlers, configuration=Configuration())
+    enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod', 'default')
+    assert handlers.wait_device_ready(enforce, timeout=600)
+    yield server, handlers
+    handlers.shutdown()
+
+
+def hetero_requests(n):
+    """Mixed users (some admins), mixed verbs, mixed verdicts — every
+    request carries a DISTINCT admission tuple."""
+    out = []
+    for i in range(n):
+        user = {'username': f'user-{i}',
+                'groups': ['system:authenticated'] +
+                          (['system:masters'] if i % 4 == 0 else []) +
+                          [f'team-{i % 5}']}
+        if i % 7 == 0:
+            user = {'username': 'root-user', 'groups': [f'team-{i % 5}']}
+        labels = {}
+        if i % 2:
+            labels['team'] = 'infra'
+        if i % 3 == 0:
+            labels['ticket'] = f'T-{i}'
+        new = pod(dict(labels), f'h{i}')
+        if i % 5 == 2:
+            out.append((f'h{i}', 'UPDATE', new, pod(dict(labels), f'h{i}'),
+                        user))
+        else:
+            out.append((f'h{i}', 'CREATE', new, None, user))
+    return out
+
+
+def _hetero_bytes(entry):
+    uid, op, new, old, user = entry
+    if op == 'UPDATE':
+        body = json.loads(update_review_bytes(new, old, uid))
+        body['request']['userInfo'] = user
+        return json.dumps(body).encode()
+    return review_bytes(new, uid, user_info=user)
+
+
+class TestHeterogeneousBatching:
+    def test_mixed_tuple_bit_identity_and_occupancy(self, hetero_chain):
+        """16 threads × distinct users/groups/verbs in one window:
+        occupancy > 1 with heterogeneous dispatches observed, every
+        response byte-identical to that request's own sync scan AND to
+        the pure host engine loop."""
+        server, handlers = hetero_chain
+        handlers._get_batcher().reset_stats()
+        requests = hetero_requests(16 * 8)
+        results = {}
+        errors = []
+        barrier = threading.Barrier(16)
+
+        def work(tid):
+            barrier.wait()
+            for entry in requests[tid * 8:(tid + 1) * 8]:
+                try:
+                    out, status = server.handle_request(
+                        '/validate/fail', _hetero_bytes(entry))
+                    assert status == 200
+                    results[entry[0]] = out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors
+        assert len(results) == len(requests)
+        stats = handlers._get_batcher().stats()
+        # the tentpole: DISTINCT admission tuples coalesced
+        assert stats['occupancy_mean'] > 1.0, stats
+        assert stats['hetero_dispatches'] >= 1, stats
+        # oracle 1: per-request sync scans (same scanner, occupancy 1)
+        prior = handlers.serving_mode
+        handlers.serving_mode = 'sync'
+        try:
+            expected = {e[0]: server.handle('/validate/fail',
+                                            _hetero_bytes(e))
+                        for e in requests}
+        finally:
+            handlers.serving_mode = prior
+        for entry in requests:
+            assert results[entry[0]] == expected[entry[0]], entry[0]
+        # oracle 2: the pure host engine loop on a verdict-bearing mix
+        prior_device = handlers.device
+        handlers.device = False
+        try:
+            for entry in requests[:24]:
+                host = server.handle('/validate/fail',
+                                     _hetero_bytes(entry))
+                assert results[entry[0]] == host, entry[0]
+        finally:
+            handlers.device = prior_device
+
+    def test_admin_gate_verdicts_depend_on_row_user(self, hetero_chain):
+        """Same pod, different users, one batch window: the subject-
+        gated rule must deny only the admin-group rows — per-row lanes,
+        not the lead rider's tuple, decide each row."""
+        server, handlers = hetero_chain
+        doc = pod({'team': 'infra'}, 'gate-pod')  # no ticket label
+        admin = {'username': 'boss', 'groups': ['system:masters']}
+        human = {'username': 'dev-1', 'groups': ['system:authenticated']}
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def work(uid, user):
+            barrier.wait()
+            results[uid] = server.handle(
+                '/validate/fail', review_bytes(doc, uid, user_info=user))
+
+        threads = [threading.Thread(target=work, args=a)
+                   for a in [('adm', admin), ('hum', human)]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert json.loads(results['adm'])['response']['allowed'] is False
+        assert json.loads(results['hum'])['response']['allowed'] is True
