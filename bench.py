@@ -726,6 +726,125 @@ def _peak_rss_mb() -> float:
     return _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def _current_rss_mb() -> float:
+    """Instantaneous resident set (``/proc/self/statm``; falls back to
+    the kernel's peak counter off Linux)."""
+    try:
+        with open('/proc/self/statm') as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf('SC_PAGE_SIZE') / (1024.0 * 1024.0)
+    except Exception:  # noqa: BLE001 - non-Linux fallback
+        return _peak_rss_mb()
+
+
+#: committed ratchet — RSS GROWTH ceiling (peak during streaming minus
+#: RSS before the scan) for the north-star streaming block at ≥100k
+#: rows.  The pre-streaming 1M run grew ~19.5GB (NORTHSTAR_1M.json:
+#: 21.6GB peak vs 2.1GB before scan) because the host built 1M decoded
+#: rows before writing anything; the bounded pipeline holds growth at
+#: O(chunk slots), measured ~0.2GB at 100k rows on CPU.  A regression
+#: toward monolithic buffering fails the bench here.
+NORTHSTAR_RSS_MB_MAX = float(os.environ.get('NORTHSTAR_RSS_MB_MAX',
+                                            '4096'))
+#: rows below which the RSS/sieve ratchets stay informational (fixed
+#: process overheads dominate tiny runs)
+NORTHSTAR_RATCHET_MIN_ROWS = 100_000
+#: committed ratchet — streaming e2e decisions/s must reach the same
+#: run's in-scan sieve rate (the ROADMAP target: report assembly fully
+#: overlapped, the report path no longer loses to the raw status path).
+#: The ratchet arms only where the overlap premise physically holds
+#: (>1 CPU: the pipeline legs need a second core to run concurrently —
+#: on a 1-core host total work is serial and e2e ⊃ sieve by
+#: construction); 1-core runs still record the ratio.
+E2E_VS_SIEVE_FLOOR = float(os.environ.get('BENCH_E2E_SIEVE_FLOOR',
+                                          '1.0'))
+E2E_VS_SIEVE_ARMS = (os.cpu_count() or 1) > 1
+
+
+class RssSampler:
+    """Background thread sampling resident-set size during a streaming
+    block: peak + a bounded time series (downsampled 2× whenever it
+    would exceed ~240 points), feeding the ``rss`` bench block and the
+    NORTHSTAR_RSS_MB_MAX ratchet."""
+
+    def __init__(self, interval_s: float = 0.25):
+        import threading
+        self.interval_s = interval_s
+        self.samples: list = []  # (t_offset_s, rss_mb)
+        self.peak_mb = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name='bench-rss-sampler',
+                                        daemon=True)
+        self._t0 = time.monotonic()
+
+    def _run(self) -> None:
+        step = self.interval_s
+        while not self._stop.is_set():
+            rss = _current_rss_mb()
+            self.peak_mb = max(self.peak_mb, rss)
+            self.samples.append(
+                (round(time.monotonic() - self._t0, 2), round(rss, 1)))
+            if len(self.samples) > 240:
+                self.samples = self.samples[::2]
+                step *= 2
+            self._stop.wait(step)
+
+    def __enter__(self) -> 'RssSampler':
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        rss = _current_rss_mb()
+        self.peak_mb = max(self.peak_mb, rss)
+
+    def block(self, before_mb: float, n_rows: int) -> dict:
+        """The ``rss`` bench block (+ the committed growth ratchet)."""
+        growth = max(self.peak_mb - before_mb, 0.0)
+        out = {
+            'before_mb': round(before_mb, 1),
+            'peak_during_stream_mb': round(self.peak_mb, 1),
+            'growth_mb': round(growth, 1),
+            'rss_per_1k_rows_mb': round(growth / max(n_rows / 1000.0, 1e-9),
+                                        3),
+            'samples': [list(s) for s in self.samples[:240]],
+            'ratchet_growth_mb_max': NORTHSTAR_RSS_MB_MAX,
+            'ratchet_applies': n_rows >= NORTHSTAR_RATCHET_MIN_ROWS,
+        }
+        if out['ratchet_applies'] and growth > NORTHSTAR_RSS_MB_MAX:
+            raise AssertionError(
+                f'streaming RSS grew {growth:.0f}MB over the scan '
+                f'(> committed NORTHSTAR_RSS_MB_MAX='
+                f'{NORTHSTAR_RSS_MB_MAX:.0f}MB at {n_rows} rows) — the '
+                'scan path is regressing toward monolithic buffering')
+        return out
+
+
+def _stage_totals() -> dict:
+    """Per-stage busy seconds snapshot (from the stage histogram)."""
+    from kyverno_tpu.observability import device as device_telemetry
+    return {stage: d['total_s']
+            for stage, d in device_telemetry.stage_breakdown().items()}
+
+
+def _overlap_block(before: dict, after: dict, wall_s: float) -> dict:
+    """Per-stage overlap ratio (stage busy-time ÷ streaming wall) over
+    one measured window.  Ratios sum past 1.0 exactly when pipeline
+    legs ran concurrently; the '_total' entry is that sum."""
+    out = {}
+    total = 0.0
+    for stage, t1 in after.items():
+        busy = t1 - before.get(stage, 0.0)
+        if busy <= 0 or wall_s <= 0:
+            continue
+        total += busy
+        out[stage] = round(busy / wall_s, 4)
+    out['_total'] = round(total / wall_s, 4) if wall_s > 0 else 0.0
+    return out
+
+
 def run_bench(n: int, platform: str, budget_s: float) -> dict:
     """Time-boxed north-star run: stream synthetic Pods through the
     report path until ``budget_s`` of measured streaming wall-clock is
@@ -736,8 +855,6 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     import random
     from kyverno_tpu.compiler.scan import BatchScanner
     from kyverno_tpu.compiler.ir import STATUS_HOST, STATUS_PASS
-    from kyverno_tpu.reports.types import new_background_scan_report
-    from kyverno_tpu.reports.results import get_results, set_responses
 
     _progress('loading policy pack')
     policies = load_policy_pack()
@@ -780,7 +897,8 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     # harness); reports are sunk incrementally so RSS stays bounded.
     host_policy_names = {scanner.policies[i].name
                          for i in scanner._host_policy_idx}
-    rss_before_mb = _peak_rss_mb()
+    rss_before_mb = _current_rss_mb()
+    stage_before = _stage_totals()
     slab = 4 * scanner.CHUNK
     decisions = 0
     compiled_decisions = 0
@@ -788,42 +906,69 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     report_results = 0
     n_done = 0
     e2e_s = 0.0
-    from kyverno_tpu.reports.results import set_fused_results
-    while n_done < n and e2e_s < budget_s:
-        m = min(slab, n - n_done)
-        pods = [make_pod(rng, i) for i in range(n_done, n_done + m)]
-        t1 = time.time()
-        slab_done = 0
-        deadline = t1 + max(budget_s - e2e_s, 5.0)
-        for resource, (results, summary, row_policies) in zip(
-                pods, scanner.scan_report_results(pods)):
-            report = new_background_scan_report(resource)
-            set_fused_results(report, results, summary, row_policies)
-            n_reports += 1
-            report_results += len(results)
-            decisions += len(results)
-            for r in results:
-                if r.get('policy') not in host_policy_names:
-                    compiled_decisions += 1
-            slab_done += 1
-            # the budget must bind even when a degraded path makes one
-            # slab slow — check inside the slab, count only what finished
-            if slab_done % 512 == 0 and time.time() > deadline:
-                break
-        e2e_s += time.time() - t1
-        n_done += slab_done
-        # slabs are ephemeral: collect the dict cycles eagerly so the
-        # north-star 1M run holds RSS flat
-        import gc
-        gc.collect()
-        _progress(f'streamed {n_done} pods, {decisions} decisions, '
-                  f'{e2e_s:.1f}s spent')
+    from kyverno_tpu.reports.types import build_fused_report
+    with RssSampler() as rss_sampler:
+        while n_done < n and e2e_s < budget_s:
+            m = min(slab, n - n_done)
+            pods = [make_pod(rng, i) for i in range(n_done, n_done + m)]
+            t1 = time.time()
+            slab_done = 0
+            deadline = t1 + max(budget_s - e2e_s, 5.0)
+            for resource, (results, summary, row_policies) in zip(
+                    pods, scanner.scan_report_results(pods)):
+                report = build_fused_report(resource, results, summary,
+                                            row_policies)
+                n_reports += 1
+                report_results += len(results)
+                decisions += len(results)
+                if host_policy_names:
+                    for r in results:
+                        if r.get('policy') not in host_policy_names:
+                            compiled_decisions += 1
+                else:
+                    compiled_decisions += len(results)
+                slab_done += 1
+                # the budget must bind even when a degraded path makes
+                # one slab slow — check inside the slab, count only
+                # what finished
+                if slab_done % 512 == 0 and time.time() > deadline:
+                    break
+            e2e_s += time.time() - t1
+            n_done += slab_done
+            # slabs are ephemeral: collect the dict cycles eagerly so
+            # the north-star 1M run holds RSS flat
+            import gc
+            gc.collect()
+            _progress(f'streamed {n_done} pods, {decisions} decisions, '
+                      f'{e2e_s:.1f}s spent')
     peak_rss_mb = _peak_rss_mb()
     rate = decisions / e2e_s if e2e_s > 0 else 0.0
+    rss_block = rss_sampler.block(rss_before_mb, n_done)
+    overlap_block = _overlap_block(stage_before, _stage_totals(), e2e_s)
+
+    # the raw status sieve (no response objects) on a bounded sample —
+    # the ROADMAP ratchet pins streaming e2e ≥ this in-scan sieve rate
+    _progress('sieve sample')
+    sieve_n = min(n_done, 20_000)
+    sieve_rng = random.Random(42)
+    sieve_pods = [make_pod(sieve_rng, i) for i in range(sieve_n)]
+    t3 = time.time()
+    status, detail, match = scanner.scan_statuses(sieve_pods)
+    sieve_s = time.time() - t3
+    sieve_rate = int(match.sum()) / sieve_s if sieve_s > 0 else 0.0
+    e2e_vs_sieve = rate / sieve_rate if sieve_rate else None
+    if E2E_VS_SIEVE_ARMS and n_done >= NORTHSTAR_RATCHET_MIN_ROWS and \
+            e2e_vs_sieve is not None and \
+            e2e_vs_sieve < E2E_VS_SIEVE_FLOOR:
+        raise AssertionError(
+            f'streaming e2e rate {rate:.0f}/s fell below the in-scan '
+            f'sieve rate {sieve_rate:.0f}/s (ratio {e2e_vs_sieve:.3f} < '
+            f'committed floor {E2E_VS_SIEVE_FLOOR}) — report assembly '
+            'is no longer hidden behind the device pipeline')
 
     if os.environ.get('BENCH_SKIP_EXTRAS') == '1':
         # north-star mode: the streaming number IS the artifact; skip
-        # the sieve/host/admission/cache-probe extras
+        # the host/admission/cache-probe extras
         device_decided_frac = \
             1.0 - materialized[0] / max(compiled_decisions, 1)
         return {
@@ -843,18 +988,16 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
             'e2e_s': round(e2e_s, 2),
             'peak_rss_mb': round(peak_rss_mb, 1),
             'rss_before_scan_mb': round(rss_before_mb, 1),
+            'rss': rss_block,
+            'streaming_overlap': overlap_block,
+            'sieve_n': sieve_n,
+            'sieve_decisions_per_sec': round(sieve_rate, 1),
+            'e2e_vs_sieve': round(e2e_vs_sieve, 3)
+            if e2e_vs_sieve is not None else None,
+            'e2e_vs_sieve_floor': E2E_VS_SIEVE_FLOOR,
+            'e2e_vs_sieve_armed': E2E_VS_SIEVE_ARMS,
         }
 
-    # the raw status sieve (no response objects), reported separately on
-    # a bounded sample
-    _progress('sieve sample')
-    sieve_n = min(n_done, 20_000)
-    sieve_rng = random.Random(42)
-    sieve_pods = [make_pod(sieve_rng, i) for i in range(sieve_n)]
-    t3 = time.time()
-    status, detail, match = scanner.scan_statuses(sieve_pods)
-    sieve_s = time.time() - t3
-    sieve_rate = int(match.sum()) / sieve_s if sieve_s > 0 else 0.0
     host_status_frac = int((match & (status == STATUS_HOST)).sum()) / \
         max(int(match.sum()), 1)
     nonpass = int(match.sum()) - int((match & (status == STATUS_PASS)).sum())
@@ -956,8 +1099,14 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'rss_before_scan_mb': round(rss_before_mb, 1),
         'cache_warm_s': round(cache_warm_s, 2),
         'warm': warm_block,
+        'rss': rss_block,
+        'streaming_overlap': overlap_block,
         'sieve_n': sieve_n,
         'sieve_decisions_per_sec': round(sieve_rate, 1),
+        'e2e_vs_sieve': round(e2e_vs_sieve, 3)
+        if e2e_vs_sieve is not None else None,
+        'e2e_vs_sieve_floor': E2E_VS_SIEVE_FLOOR,
+        'e2e_vs_sieve_armed': E2E_VS_SIEVE_ARMS,
         'host_engine_decisions_per_sec': round(host_rate, 1),
         'speedup_vs_host_engine': round(rate / host_rate, 2)
         if host_rate else None,
@@ -1417,13 +1566,19 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
 
     _progress(f'rescan churn bench: {n} rows, {ticks} ticks @ {ratio}')
     ctrl = _churn_controller(policies, resources, cache_dir, enabled=True)
-    t0 = time.time()
-    ctrl.enqueue_all()
-    ctrl.reconcile()  # cold tick: populate the cache
-    cold_s = time.time() - t0
-    lat, scanned, replayed = run_ticks(ctrl, ticks)
+    rss_before = _current_rss_mb()
+    with RssSampler() as rss_sampler:
+        t0 = time.time()
+        ctrl.enqueue_all()
+        ctrl.reconcile()  # cold tick: populate the cache
+        cold_s = time.time() - t0
+        lat, scanned, replayed = run_ticks(ctrl, ticks)
     total = [s + r for s, r in zip(scanned, replayed)]
     scanned_ratio = sum(scanned) / max(sum(total), 1)
+    # the fake client retains every written report, so rescan growth is
+    # O(reports) by design — the ratchet still bounds regression toward
+    # re-materializing all N decoded rows per tick
+    rss_block = rss_sampler.block(rss_before, n)
 
     _progress(f'rescan dense baseline: {dense_ticks} tick(s)')
     dense = _churn_controller(policies, resources, cache_dir,
@@ -1435,6 +1590,7 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
     block = {
         'n_rows': n, 'churn_ticks': ticks, 'churn_ratio': ratio,
         'platform': platform,
+        'rss': rss_block,
         'rows_scanned_per_tick': scanned,
         'rows_replayed_per_tick': replayed,
         'scanned_rows_ratio': round(scanned_ratio, 4),
@@ -1773,6 +1929,15 @@ def main() -> int:
         else:
             result = run_bench(n, platform, budget_s)
         result['stage_breakdown'] = device_telemetry.stage_breakdown()
+        # per-stage overlap ratio (streaming busy-time ÷ streaming
+        # wall) measured over the headline window: >1 total means the
+        # pipeline legs genuinely ran concurrently
+        for stage, ratio in (result.get('streaming_overlap') or {}).items():
+            if stage == '_total':
+                result['stage_breakdown']['_overall'] = {
+                    'overlap_ratio': ratio}
+            elif stage in result['stage_breakdown']:
+                result['stage_breakdown'][stage]['overlap_ratio'] = ratio
         # executable-cache outcomes + persisted AOT store state: warm_s
         # regressions are diagnosable from the JSON line alone (was the
         # store cold, disabled, or bypassed?)

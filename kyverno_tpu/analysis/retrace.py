@@ -264,6 +264,86 @@ def _looks_computed(expr: ast.AST) -> bool:
     return False
 
 
+#: functions whose bodies (plus their one-level same-file callees) ARE
+#: the streaming encode hot path — per-row allocations here run a
+#: million times per scan
+_HOT_ENTRIES = frozenset({'encode_batch', 'encode_mutate_batch'})
+#: call names that materialize per-row garbage: dict() construction,
+#: deep copies, JSON serialization
+_PER_ROW_ALLOC_CALLS = frozenset({'deepcopy', 'dumps', 'dict'})
+#: comprehension nodes: their element expressions run once per
+#: iteration, exactly like a loop body
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                   ast.DictComp)
+
+
+def _flag_hot_loop_allocs(sf, fn: ast.AST) -> Iterable[Finding]:
+    found: List[ast.AST] = []
+
+    def visit(node: ast.AST, in_loop: bool) -> None:
+        if in_loop:
+            if isinstance(node, (ast.Dict, ast.DictComp)):
+                found.append(node)
+            elif isinstance(node, ast.Call) and \
+                    _callee_name(node.func) in _PER_ROW_ALLOC_CALLS:
+                found.append(node)
+        inner = in_loop or isinstance(
+            node, (ast.For, ast.AsyncFor, ast.While) + _COMPREHENSIONS)
+        for child in ast.iter_child_nodes(node):
+            visit(child, inner)
+
+    visit(fn, False)
+    lines_seen: set = set()
+    for node in found:
+        if node.lineno in lines_seen:
+            continue  # one finding per line, however many dicts it holds
+        lines_seen.add(node.lineno)
+        what = 'dict construction' if isinstance(
+            node, (ast.Dict, ast.DictComp)) else \
+            f'`{_callee_name(node.func)}(...)`'
+        yield sf.finding(
+            'KTPU205', node,
+            f'per-row {what} inside `{fn.name}` on the streaming '
+            f'encode hot path — hoist it out of the loop, reuse a '
+            f'shared buffer/context, or go columnar '
+            f'(encode.Lanes.encode_column)')
+
+
+@register('KTPU205', 'per-row dict/deepcopy/json.dumps construction in '
+                     'a function reachable from the streaming encode '
+                     'hot path (encode_batch/encode_mutate_batch + '
+                     'one-level callees) — allocations here run once '
+                     'per resource per chunk')
+def _check_hot_path_allocs(ctx: Context) -> Iterable[Finding]:
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        defs: dict = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        entries = [defs[n] for n in sorted(_HOT_ENTRIES) if n in defs]
+        if not entries:
+            continue
+        # the hot set: the encode entries plus every same-file function
+        # they call directly (bare-name resolution, one level — the
+        # same local-dataflow depth as KTPU204)
+        hot: List[ast.AST] = []
+        seen: set = set()
+        for fn in entries:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                hot.append(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    target = defs.get(_callee_name(node.func))
+                    if target is not None and id(target) not in seen:
+                        seen.add(id(target))
+                        hot.append(target)
+        for fn in hot:
+            yield from _flag_hot_loop_allocs(sf, fn)
+
+
 @register('KTPU204', 'batch-encode padded_n not drawn from the '
                      'canonical shape table (compiler/shapes.py) — '
                      'each computed row count mints a fresh XLA '

@@ -161,6 +161,36 @@ class Lanes:
                 out[f'{prefix}_{name}'] = v
         return out
 
+    def clear(self) -> None:
+        """Zero every lane in place (arena reuse between chunks)."""
+        for name in self._LANE_NAMES:
+            v = getattr(self, name)
+            if v is not None:
+                v.fill(0)
+
+    def encode_column(self, idx, values: list, palette: '_Palette') -> None:
+        """Columnar encode: dictionary-encode ``values`` through
+        ``palette`` (one scalar :meth:`encode` per DISTINCT value, ever)
+        and scatter the palette rows into the lanes with one vectorized
+        assignment per lane.  ``idx`` is ``None`` for a full leading-
+        rows column (rows ``0..len(values)``) or a tuple of equal-length
+        index arrays for element-scoped columns."""
+        if not values:
+            return
+        with palette.lock:
+            codes = palette.codes_for(values)
+            src = palette.lanes
+            m = len(values)
+            for name in self._LANE_NAMES:
+                dst = getattr(self, name)
+                if dst is None:
+                    continue
+                s = getattr(src, name)
+                if idx is None:
+                    dst[:m] = s[codes]
+                else:
+                    dst[idx] = s[codes]
+
     # -- value encoding ------------------------------------------------------
 
     def encode(self, idx, value: Any, string_form: Optional[str] = None,
@@ -289,6 +319,142 @@ class Lanes:
             tail = b[-TAIL_LEN:]
             self.str_tail[idx][TAIL_LEN - len(tail):] = \
                 np.frombuffer(tail, np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# columnar dictionary encoding: one scalar encode per DISTINCT value
+
+#: singleton palette keys for the classes whose encoding ignores the
+#: value (encode() writes only the type tag for these)
+_KEY_MAP = ('__map__',)
+_KEY_ARR = ('__array__',)
+_KEY_OTHER = ('__other__',)
+_KEY_NONE = ('__null__',)
+_KEY_MISSING = ('__missing__',)
+
+
+class _Palette:
+    """Dictionary encoder for one lane column (slot or gather).
+
+    Values in a policy-scan batch repeat massively — image names,
+    booleans, quantity strings, label values — so the palette runs the
+    scalar :meth:`Lanes.encode` once per distinct value and remembers
+    the encoded lane row; subsequent chunks pay one dict lookup per
+    value instead of a dozen numpy scalar writes.  Palettes persist
+    across chunks on the :class:`LaneArena`, so a steady-state stream
+    encodes almost entirely through vectorized gathers."""
+
+    __slots__ = ('lanes', 'needs', 'sprint', 'codes', 'cap', 'lock')
+
+    #: distinct-value bound: a column exceeding it (adversarial
+    #: high-cardinality values) resets rather than growing unbounded
+    MAX_ENTRIES = 65536
+
+    def __init__(self, needs: LaneNeeds, sprint: bool):
+        self.needs = needs
+        self.sprint = sprint
+        self.cap = 64
+        self.lanes = Lanes((self.cap,), needs)
+        self.codes: Dict[tuple, int] = {}
+        self.lock = __import__('threading').Lock()
+
+    def _grow(self) -> None:
+        new_cap = self.cap * 2
+        new = Lanes((new_cap,), self.needs)
+        for name in Lanes._LANE_NAMES:
+            src = getattr(self.lanes, name)
+            if src is not None:
+                getattr(new, name)[:self.cap] = src
+        self.lanes = new
+        self.cap = new_cap
+
+    def _key(self, value: Any) -> tuple:
+        # mirrors the isinstance ladder of Lanes.encode exactly: two
+        # values share a palette row only when encode() cannot tell
+        # them apart
+        if value is _MISSING:
+            return _KEY_MISSING
+        if value is None:
+            return _KEY_NONE
+        if isinstance(value, bool):
+            return (bool, value)
+        if isinstance(value, int):
+            return (int, value)
+        if isinstance(value, float):
+            # repr distinguishes -0.0 from 0.0 (their Go string forms
+            # differ) and collapses every NaN onto one row
+            return (float, repr(value))
+        if isinstance(value, str):
+            return (str, value)
+        if isinstance(value, dict):
+            return _KEY_MAP
+        if isinstance(value, list):
+            return _KEY_ARR
+        return _KEY_OTHER
+
+    def code(self, value: Any) -> int:
+        key = self._key(value)
+        c = self.codes.get(key)
+        if c is None:
+            if len(self.codes) >= self.MAX_ENTRIES:
+                self.codes.clear()
+                self.lanes.clear()
+            c = len(self.codes)
+            if c >= self.cap:
+                self._grow()
+            self.lanes.encode(c, value, sprint_form=self.sprint)
+            self.codes[key] = c
+        return c
+
+    def codes_for(self, values: list) -> np.ndarray:
+        return np.fromiter(map(self.code, values), np.intp,
+                           count=len(values))
+
+
+class LaneArena:
+    """Bounded pool of reusable encode buffers plus the cross-chunk
+    palettes for one compiled policy set.
+
+    The streaming scan pipeline holds a small fixed number of chunks in
+    flight; the arena recycles their lane tensors (zeroed in place)
+    instead of allocating ~100MB of numpy arrays per chunk, which is
+    what kept the 1M-resource path allocating monotonically.  A batch
+    is released back only after its device inputs are freed (d2h
+    complete), so a zero-copy host-to-device path can never observe a
+    recycled buffer."""
+
+    def __init__(self, max_pool: int = 4):
+        #: buffers kept per shape key; 0 = palettes only (forked encode
+        #: workers pickle tensors after return, so recycling there could
+        #: zero a buffer mid-serialization)
+        self.max_pool = max_pool
+        self._lock = __import__('threading').Lock()
+        self._free: Dict[tuple, List['Batch']] = {}
+        self._palettes: Dict[tuple, _Palette] = {}
+
+    def palette(self, key: tuple, needs: LaneNeeds,
+                sprint: bool) -> _Palette:
+        with self._lock:
+            pal = self._palettes.get(key)
+            if pal is None:
+                pal = self._palettes[key] = _Palette(needs, sprint)
+            return pal
+
+    def acquire(self, key: tuple) -> Optional['Batch']:
+        with self._lock:
+            pool = self._free.get(key)
+            if pool:
+                return pool.pop()
+        return None
+
+    def release(self, batch: 'Batch') -> None:
+        key = getattr(batch, 'arena_key', None)
+        if key is None:
+            return
+        with self._lock:
+            pool = self._free.setdefault(key, [])
+            if len(pool) < self.max_pool:
+                pool.append(batch)
 
 
 # ---------------------------------------------------------------------------
@@ -539,12 +705,32 @@ class Batch:
         self.n = n
         #: live rows; rows [row_count, n) are canonical-capacity padding
         self.row_count = n if row_count is None else row_count
+        #: set when the batch came from a LaneArena pool (recycle key)
+        self.arena_key: Optional[tuple] = None
         self.slot_lanes: Dict[Slot, Lanes] = {}
         self.array_meta: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
         self.gather_lanes: Dict[GatherSlot, Lanes] = {}
         self.gather_meta: Dict[GatherSlot, Dict[str, np.ndarray]] = {}
         self.elem_lanes: Dict[Any, Lanes] = {}
         self.elem_meta: Dict[Any, Dict[str, np.ndarray]] = {}
+
+    def clear(self) -> None:
+        """Zero every tensor in place for arena reuse."""
+        for lanes in self.slot_lanes.values():
+            lanes.clear()
+        for lanes in self.gather_lanes.values():
+            lanes.clear()
+        for lanes in self.elem_lanes.values():
+            lanes.clear()
+        for meta in self.array_meta.values():
+            for arr in meta.values():
+                arr.fill(0)
+        for meta in self.gather_meta.values():
+            for arr in meta.values():
+                arr.fill(0)
+        for meta in self.elem_meta.values():
+            for arr in meta.values():
+                arr.fill(0)
 
     def tensors(self) -> Dict[str, np.ndarray]:
         # the row-validity lane rides with every batch: the ragged
@@ -633,7 +819,8 @@ def _has_null_dict_value(v) -> bool:
 
 def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                  padded_n: int = 0,
-                 contexts: Optional[List[dict]] = None) -> Batch:
+                 contexts: Optional[List[dict]] = None,
+                 arena: Optional[LaneArena] = None) -> Batch:
     """``contexts`` overrides the per-resource gather context (admission
     scans thread operation/userInfo/oldObject through; defaults to the
     background-scan context {'request': {'object': doc}}).
@@ -641,22 +828,35 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
     ``padded_n`` is a *capacity*: rows [len(resources), padded_n) stay
     all-TAG_MISSING and are marked invalid on the ``__rowvalid__`` lane
     (callers draw it from the canonical shape table —
-    ``compiler/shapes.py`` — so XLA only ever sees those shapes)."""
+    ``compiler/shapes.py`` — so XLA only ever sees those shapes).
+
+    ``arena`` recycles lane tensors across chunks and keeps the
+    cross-chunk value palettes (columnar dictionary encoding); without
+    one, an ephemeral arena serves this call only.  Encoding is
+    column-major throughout: per-slot value columns are extracted with
+    one dict-walk pass, dictionary-encoded, and scattered into the
+    preallocated lanes — no per-row intermediate dicts or per-cell
+    numpy writes on the hot path."""
     n = max(len(resources), padded_n)
-    batch = Batch(n, row_count=len(resources))
+    n_rows = len(resources)
     slot_needs, gather_needs, elem_needs, array_paths = _needs_cached(cps)
+    pooled = arena is not None
+    if arena is None:
+        arena = LaneArena()
 
     # element width: sized to the longest observed list (pow-2 clamped) —
     # real batches rarely approach MAX_ELEMS, and the element axis
     # multiplies every element-scoped lane's bytes
     containers = _container_paths(cps, array_paths)
     elems = _pow2_clamp(_measure_elems(resources, containers), 4, MAX_ELEMS)
-    batch.elems = elems
 
     # gather projections are evaluated against the same RFC-7386
     # merge-patched context the host Context builds (null-valued map keys
     # stripped; engine/context.py:36 merge_patch) — a variable resolving
-    # to an explicit null must raise NotFound exactly like the host
+    # to an explicit null must raise NotFound exactly like the host.
+    # Background scans reuse ONE shared context dict across rows (its
+    # inner request.object is repointed per row), so the hot path builds
+    # no per-row context dicts.
     from ..engine.context import merge_patch
 
     def _merged(doc: dict) -> dict:
@@ -665,32 +865,45 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
         # skip the rebuild, which otherwise dominates context setup
         return merge_patch({}, doc) if _has_null_dict_value(doc) else doc
 
-    if contexts is not None:
-        bases = [_merged(c) for c in contexts]
-    else:
-        bases = [{'request': {'object': _merged(doc)}}
-                 for doc in resources]
-    gather_results = {
-        g: [_run_gather_ctx(searcher, base) for base in bases]
-        for g, searcher in ((g, _gather_searcher(g)) for g in cps.gathers)}
+    searchers = [(g, _gather_searcher(g)) for g in cps.gathers]
+    gather_results: Dict[GatherSlot, list] = \
+        {g: [None] * n_rows for g in cps.gathers}
+    bases: Optional[List[dict]] = None
+    if searchers or cps.elem_gathers:
+        if contexts is not None:
+            bases = [_merged(c) for c in contexts]
+        else:
+            shared_inner: Dict[str, Any] = {'object': None}
+            shared_ctx = {'request': shared_inner}
+        for r in range(n_rows):
+            if bases is not None:
+                ctx = bases[r]
+            else:
+                shared_inner['object'] = _merged(resources[r])
+                ctx = shared_ctx
+            for g, searcher in searchers:
+                gather_results[g][r] = _run_gather_ctx(searcher, ctx)
     longest_g = 1
     for results in gather_results.values():
         for marker, value in results:
             if marker == 'list':
                 longest_g = max(longest_g, len(value))
     gwidth = _pow2_clamp(longest_g, 4, MAX_GATHER)
-    batch.gather_width = gwidth
 
     # foreach element gathers: evaluate each expr per element of its list
     # (reusing the list gather's results) under the element context the
     # host injects (engine/context.py:109 add_element)
     elem_results: Dict[Any, List[List[Tuple[str, Any]]]] = {}
     longest_eg = 1
+    # background scans reuse one shared base context across rows here
+    # too (its inner request.object repoints per row)
+    eshared_inner: Dict[str, Any] = {'object': None}
+    eshared_ctx = {'request': eshared_inner}
     for eg in cps.elem_gathers:
         searcher = _gather_searcher(GatherSlot(eg.expr))
         lres = gather_results.get(GatherSlot(eg.list_expr))
         per_resource: List[List[Tuple[str, Any]]] = []
-        for r, doc in enumerate(resources):
+        for r in range(n_rows):
             marker, value = lres[r]
             if marker == 'list':
                 elements = value
@@ -699,6 +912,11 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
             else:
                 per_resource.append([])
                 continue
+            if bases is not None:
+                base = bases[r]
+            else:
+                eshared_inner['object'] = _merged(resources[r])
+                base = eshared_ctx
             row: List[Tuple[str, Any]] = []
             for fe, elem in enumerate(elements[:gwidth]):
                 if elem is None:
@@ -708,9 +926,16 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
                 # add_element (context.py:109) — nulls stripped again;
                 # the merge only rewrites the element subtree, so build
                 # the top level directly and strip just the element
+                # ktpu: noqa[KTPU205] -- merge_patch needs a fresh
+                # accumulator; only elements carrying explicit nulls
+                # (rare) take this branch
                 stripped = merge_patch({}, elem) \
                     if _has_null_dict_value(elem) else elem
-                ctx = {**bases[r],
+                # ktpu: noqa[KTPU205] -- the per-element context IS the
+                # engine's add_element semantics (one injected context
+                # per foreach element); foreach gathers are off the
+                # streaming fast path
+                ctx = {**base,
                        'element': stripped, 'element0': stripped,
                        'elementIndex': fe, 'elementIndex0': fe}
                 m2, v2 = _run_gather_ctx(searcher, ctx)
@@ -720,56 +945,164 @@ def encode_batch(resources: List[dict], cps: CompiledPolicySet,
             per_resource.append(row)
         elem_results[eg] = per_resource
     egwidth = _pow2_clamp(longest_eg, 4, MAX_GATHER)
+
+    key = (n, elems, gwidth, egwidth)
+    batch = arena.acquire(key) if pooled else None
+    if batch is None:
+        batch = _build_batch(cps, n, elems, gwidth, egwidth, slot_needs,
+                             gather_needs, elem_needs, array_paths)
+        if pooled:
+            batch.arena_key = key
+    else:
+        batch.clear()
+    batch.row_count = n_rows
+    batch.elems = elems
+    batch.gather_width = gwidth
     batch.elem_gather_width = egwidth
 
-    # array metadata channels (count/overflow/tag) for forall/exists nodes
+    plan0, groups, metas = _slot_plan_cached(cps)
+
+    # array metadata channels (count/overflow/tag), column-wise
+    for full, prefix, rest in metas:
+        meta = batch.array_meta[full]
+        if rest is None:
+            vals = [_walk(doc, prefix) for doc in resources]
+            _set_array_meta_column(meta, None, vals, elems)
+        else:
+            r_idx: List[int] = []
+            e_idx: List[int] = []
+            vals = []
+            for r, doc in enumerate(resources):
+                container = _walk(doc, prefix)
+                if not isinstance(container, list):
+                    continue
+                for e, elem in enumerate(container[:elems]):
+                    r_idx.append(r)
+                    e_idx.append(e)
+                    vals.append(_walk(elem, rest)
+                                if isinstance(elem, dict) else _MISSING)
+            if vals:
+                _set_array_meta_column(
+                    meta, (np.asarray(r_idx, np.intp),
+                           np.asarray(e_idx, np.intp)), vals, elems)
+
+    # scalar slots: one value column per slot
+    for path, slot in plan0:
+        lanes = batch.slot_lanes[slot]
+        vals = [_walk(doc, path) for doc in resources]
+        lanes.encode_column(None, vals,
+                            arena.palette(('s', slot), lanes.needs, False))
+
+    # element slots: each container (and each element) is visited once
+    # for all the slots under it; values land in per-slot columns
+    for prefix, g in groups.items():
+        d1, d2 = g['d1'], g['d2']
+        cols1 = [([], [], []) for _ in d1]
+        # ktpu: noqa[KTPU205] -- one accumulator dict per container
+        # GROUP (a handful per policy set), not per row
+        cols2 = {mk: [([], [], [], []) for _ in members]
+                 for mk, members in d2.items()}
+        for r, doc in enumerate(resources):
+            container = _walk(doc, prefix)
+            if not isinstance(container, list):
+                continue  # lanes stay TAG_MISSING; array guards handle it
+            for e, elem in enumerate(container[:elems]):
+                is_map = isinstance(elem, dict)
+                for si, (rest1, _slot) in enumerate(d1):
+                    rr, ee, vv = cols1[si]
+                    rr.append(r)
+                    ee.append(e)
+                    if not rest1:
+                        vv.append(elem)
+                    else:
+                        vv.append(_walk(elem, rest1)
+                                  if is_map else _MISSING)
+                for mk, members in d2.items():
+                    inner = _walk(elem, mk) if is_map else _MISSING
+                    if not isinstance(inner, list):
+                        continue
+                    mcols = cols2[mk]
+                    for e2, elem2 in enumerate(inner[:elems]):
+                        inner_map = isinstance(elem2, dict)
+                        for sj, (rest2, _slot2) in enumerate(members):
+                            rr, ee, e2l, vv = mcols[sj]
+                            rr.append(r)
+                            ee.append(e)
+                            e2l.append(e2)
+                            if not rest2:
+                                vv.append(elem2)
+                            else:
+                                vv.append(_walk(elem2, rest2)
+                                          if inner_map else _MISSING)
+        for si, (rest1, slot) in enumerate(d1):
+            rr, ee, vv = cols1[si]
+            if vv:
+                lanes = batch.slot_lanes[slot]
+                lanes.encode_column(
+                    (np.asarray(rr, np.intp), np.asarray(ee, np.intp)),
+                    vv, arena.palette(('s', slot), lanes.needs, False))
+        for mk, members in d2.items():
+            for sj, (rest2, slot2) in enumerate(members):
+                rr, ee, e2l, vv = cols2[mk][sj]
+                if vv:
+                    lanes = batch.slot_lanes[slot2]
+                    lanes.encode_column(
+                        (np.asarray(rr, np.intp), np.asarray(ee, np.intp),
+                         np.asarray(e2l, np.intp)),
+                        vv, arena.palette(('s', slot2), lanes.needs,
+                                          False))
+
+    for g in cps.gathers:
+        lanes, meta = batch.gather_lanes[g], batch.gather_meta[g]
+        _fill_gather_column(gather_results[g], lanes, meta, gwidth,
+                            arena.palette(('g', g), lanes.needs, True))
+    for eg in cps.elem_gathers:
+        lanes, meta = batch.elem_lanes[eg], batch.elem_meta[eg]
+        _fill_elem_gather_column(
+            elem_results[eg], lanes, meta, egwidth,
+            arena.palette(('e', eg), lanes.needs, True))
+    return batch
+
+
+def _build_batch(cps: CompiledPolicySet, n: int, elems: int, gwidth: int,
+                 egwidth: int, slot_needs, gather_needs, elem_needs,
+                 array_paths) -> Batch:
+    """Allocate the full lane tensor set for one batch shape (reused
+    across chunks via the LaneArena)."""
+    batch = Batch(n)
     for path in array_paths:
         depth = sum(1 for p in path if p == '*')
         shape = (n,) + (elems,) * depth
+        # ktpu: noqa[KTPU205] -- per-SLOT lane allocation (runs once per
+        # batch shape, then recycles through the arena), not per row
         batch.array_meta[path] = {
             'count': np.zeros(shape, np.int32),
             'overflow': np.zeros(shape, bool),
             'tag': np.zeros(shape, np.int8),
         }
-
     for slot in cps.slots:
         shape = (n,) + (elems,) * slot.depth
         batch.slot_lanes[slot] = Lanes(shape, slot_needs[slot])
-
     for g in cps.gathers:
         batch.gather_lanes[g] = Lanes((n, gwidth), gather_needs[g])
+        # ktpu: noqa[KTPU205] -- per-GATHER metadata allocation (arena-
+        # recycled), not per row
         batch.gather_meta[g] = {
             'kind': np.zeros(n, np.int8),
             'count': np.zeros(n, np.int32),
             'overflow': np.zeros(n, bool),
             'notfound': np.zeros(n, bool),
         }
-
     for eg in cps.elem_gathers:
         batch.elem_lanes[eg] = Lanes((n, gwidth, egwidth), elem_needs[eg])
+        # ktpu: noqa[KTPU205] -- per-GATHER metadata allocation (arena-
+        # recycled), not per row
         batch.elem_meta[eg] = {
             'kind': np.zeros((n, gwidth), np.int8),
             'count': np.zeros((n, gwidth), np.int32),
             'overflow': np.zeros((n, gwidth), bool),
             'notfound': np.zeros((n, gwidth), bool),
         }
-
-    slot_plan = _slot_plan(cps, batch)
-    for r, doc in enumerate(resources):
-        _encode_doc(r, doc, slot_plan, batch, elems)
-    for g in cps.gathers:
-        lanes, meta = batch.gather_lanes[g], batch.gather_meta[g]
-        results = gather_results[g]
-        for r, (marker, value) in enumerate(results):
-            _fill_gather(r, marker, value, lanes, meta, gwidth)
-    for eg in cps.elem_gathers:
-        lanes, meta = batch.elem_lanes[eg], batch.elem_meta[eg]
-        rows = elem_results[eg]
-        for r, row in enumerate(rows):
-            for fe, (marker, value) in enumerate(row):
-                if marker == 'null':
-                    continue  # null foreach elements are skipped entirely
-                _fill_gather((r, fe), marker, value, lanes, meta, egwidth)
     return batch
 
 
@@ -781,95 +1114,73 @@ def _needs_cached(cps: CompiledPolicySet):
     return cached
 
 
-def _slot_plan(cps: CompiledPolicySet, batch: Batch):
-    """Precomputed walk plan: scalar slots as flat (path, lanes) pairs;
-    element slots grouped by container prefix so each array (and each
-    element) is visited once for all the slots under it."""
+def _slot_plan_cached(cps: CompiledPolicySet):
+    """Precomputed walk plan (batch-independent, cached on the cps):
+    scalar slots as flat (path, slot) pairs; element slots grouped by
+    container prefix so each array (and each element) is visited once
+    for all the slots under it; array-meta paths split into
+    (full path, prefix, rest)."""
+    cached = getattr(cps, '_slot_plan_cache', None)
+    if cached is not None:
+        return cached
     plan0 = []
     groups: Dict[Tuple[str, ...], dict] = {}
     for slot in cps.slots:
-        lanes = batch.slot_lanes[slot]
         d = slot.depth
         if d == 0:
-            plan0.append((slot.path, lanes))
+            plan0.append((slot.path, slot))
             continue
         star1 = slot.path.index('*')
         prefix, rest1 = slot.path[:star1], slot.path[star1 + 1:]
+        # ktpu: noqa[KTPU205] -- walk-plan construction, cached on the
+        # cps: runs once per policy set, never per row
         g = groups.setdefault(prefix, {'d1': [], 'd2': {}})
         if d == 1:
-            g['d1'].append((rest1, lanes))
+            g['d1'].append((rest1, slot))
         else:
             star2 = rest1.index('*')
             g['d2'].setdefault(rest1[:star2], []).append(
-                (rest1[star2 + 1:], lanes))
-    # array-meta walk plan: (path, meta, star1 or None, rest)
+                (rest1[star2 + 1:], slot))
+    _needs = _needs_cached(cps)
     metas = []
-    for path, meta in batch.array_meta.items():
+    for path in _needs[3]:
         if '*' in path:
             star1 = path.index('*')
-            metas.append((path[:star1], meta, path[star1 + 1:]))
+            metas.append((path, path[:star1], path[star1 + 1:]))
         else:
-            metas.append((path, meta, None))
-    return plan0, groups, metas
+            metas.append((path, path, None))
+    cached = (plan0, groups, metas)
+    cps._slot_plan_cache = cached
+    return cached
 
 
-def _encode_doc(r: int, doc: dict, slot_plan, batch: Batch,
-                elems: int) -> None:
-    plan0, groups, metas = slot_plan
-    for path, meta, rest in metas:
-        if rest is None:
-            _set_array_meta(meta, r, _walk(doc, path), elems)
-            continue
-        container = _walk(doc, path)
-        if not isinstance(container, list):
-            continue
-        for e, elem in enumerate(container[:elems]):
-            value = _walk(elem, rest) if isinstance(elem, dict) else _MISSING
-            _set_array_meta(meta, (r, e), value, elems)
-    for path, lanes in plan0:
-        lanes.encode(r, _walk(doc, path))
-    for prefix, g in groups.items():
-        container = _walk(doc, prefix)
-        if not isinstance(container, list):
-            continue  # lanes stay TAG_MISSING; array guards handle it
-        d1, d2 = g['d1'], g['d2']
-        for e, elem in enumerate(container[:elems]):
-            re = (r, e)
-            is_map = isinstance(elem, dict)
-            for rest1, lanes in d1:
-                if not rest1:
-                    lanes.encode(re, elem)
-                else:
-                    lanes.encode(
-                        re, _walk(elem, rest1) if is_map else _MISSING)
-            for mid, members in d2.items():
-                inner = _walk(elem, mid) if is_map else _MISSING
-                if not isinstance(inner, list):
-                    continue
-                for e2, elem2 in enumerate(inner[:elems]):
-                    ree = (r, e, e2)
-                    inner_map = isinstance(elem2, dict)
-                    for rest2, lanes in members:
-                        if not rest2:
-                            lanes.encode(ree, elem2)
-                        else:
-                            lanes.encode(ree, _walk(elem2, rest2)
-                                         if inner_map else _MISSING)
-
-
-def _set_array_meta(meta, idx, value, elems: int) -> None:
-    if value is _MISSING:
-        meta['tag'][idx] = TAG_MISSING
-    elif isinstance(value, list):
-        meta['tag'][idx] = TAG_ARRAY
-        meta['count'][idx] = min(len(value), elems)
-        meta['overflow'][idx] = len(value) > elems
-    elif value is None:
-        meta['tag'][idx] = TAG_NULL
-    elif isinstance(value, dict):
-        meta['tag'][idx] = TAG_MAP
+def _set_array_meta_column(meta, idx, values: list, elems: int) -> None:
+    """Vectorized array-metadata fill for one column of walked values."""
+    m = len(values)
+    tag = np.zeros(m, np.int8)
+    count = np.zeros(m, np.int32)
+    ovf = np.zeros(m, bool)
+    for i, value in enumerate(values):
+        if value is _MISSING:
+            tag[i] = TAG_MISSING
+        elif isinstance(value, list):
+            tag[i] = TAG_ARRAY
+            count[i] = min(len(value), elems)
+            ovf[i] = len(value) > elems
+        elif value is None:
+            tag[i] = TAG_NULL
+        elif isinstance(value, dict):
+            tag[i] = TAG_MAP
+        else:
+            tag[i] = TAG_STRING  # non-array scalar: guards only
+    if idx is None:
+        meta['tag'][:m] = tag
+        meta['count'][:m] = count
+        meta['overflow'][:m] = ovf
     else:
-        meta['tag'][idx] = TAG_STRING  # non-array scalar: guards only
+        meta['tag'][idx] = tag
+        meta['count'][idx] = count
+        meta['overflow'][idx] = ovf
 
 
 def _gather_searcher(g: GatherSlot):
@@ -903,27 +1214,119 @@ def _run_gather_ctx(searcher, ctx: dict):
     return 'scalar', result
 
 
-def _fill_gather(r, marker: str, value, lanes: Lanes, meta,
-                 gwidth: int) -> None:
-    """Fill one gather row; ``r`` is an int (plain gathers) or an
-    (r, fe) tuple (per-foreach-element gathers)."""
-    idx = r if isinstance(r, tuple) else (r,)
-    if marker == 'notfound':
-        meta['notfound'][idx] = True
-        return
-    if marker == 'raised':
-        meta['overflow'][idx] = True
-        return
-    if marker == 'null':
-        return
-    if marker == 'list':
-        meta['kind'][idx] = 2
-        meta['count'][idx] = min(len(value), gwidth)
-        if len(value) > gwidth:
-            meta['overflow'][idx] = True
-        for e, v in enumerate(value[:gwidth]):
-            lanes.encode(idx + (e,), v, sprint_form=True)
-        return
-    meta['kind'][idx] = 1
-    meta['count'][idx] = 1
-    lanes.encode(idx + (0,), value, sprint_form=True)
+def _fill_gather_column(results: list, lanes: Lanes, meta, gwidth: int,
+                        palette: _Palette) -> None:
+    """Columnar fill of one gather's whole result column: metadata
+    channels batch into single vectorized writes, element values flow
+    through the palette encoder."""
+    r_idx: List[int] = []
+    e_idx: List[int] = []
+    vals: list = []
+    nf: List[int] = []
+    ovf: List[int] = []
+    kind1: List[int] = []
+    kind2: List[int] = []
+    counts: List[int] = []
+    for r, (marker, value) in enumerate(results):
+        if marker == 'notfound':
+            nf.append(r)
+            continue
+        if marker == 'raised':
+            ovf.append(r)
+            continue
+        if marker == 'null':
+            continue
+        if marker == 'list':
+            kind2.append(r)
+            counts.append(min(len(value), gwidth))
+            if len(value) > gwidth:
+                ovf.append(r)
+            for e, v in enumerate(value[:gwidth]):
+                r_idx.append(r)
+                e_idx.append(e)
+                vals.append(v)
+            continue
+        kind1.append(r)
+        r_idx.append(r)
+        e_idx.append(0)
+        vals.append(value)
+    if nf:
+        meta['notfound'][np.asarray(nf, np.intp)] = True
+    if ovf:
+        meta['overflow'][np.asarray(ovf, np.intp)] = True
+    if kind1:
+        k1 = np.asarray(kind1, np.intp)
+        meta['kind'][k1] = 1
+        meta['count'][k1] = 1
+    if kind2:
+        k2 = np.asarray(kind2, np.intp)
+        meta['kind'][k2] = 2
+        meta['count'][k2] = np.asarray(counts, np.int32)
+    if vals:
+        lanes.encode_column(
+            (np.asarray(r_idx, np.intp), np.asarray(e_idx, np.intp)),
+            vals, palette)
+
+
+def _fill_elem_gather_column(rows: list, lanes: Lanes, meta, egwidth: int,
+                             palette: _Palette) -> None:
+    """Columnar fill for a per-foreach-element gather: same channels as
+    :func:`_fill_gather_column` with a (row, foreach-element) leading
+    index."""
+    r_idx: List[int] = []
+    f_idx: List[int] = []
+    e_idx: List[int] = []
+    vals: list = []
+    nf: List[Tuple[int, int]] = []
+    ovf: List[Tuple[int, int]] = []
+    kind1: List[Tuple[int, int]] = []
+    kind2: List[Tuple[int, int]] = []
+    counts: List[int] = []
+    for r, row in enumerate(rows):
+        for fe, (marker, value) in enumerate(row):
+            if marker == 'null':
+                continue  # null foreach elements are skipped entirely
+            if marker == 'notfound':
+                nf.append((r, fe))
+                continue
+            if marker == 'raised':
+                ovf.append((r, fe))
+                continue
+            if marker == 'list':
+                kind2.append((r, fe))
+                counts.append(min(len(value), egwidth))
+                if len(value) > egwidth:
+                    ovf.append((r, fe))
+                for e, v in enumerate(value[:egwidth]):
+                    r_idx.append(r)
+                    f_idx.append(fe)
+                    e_idx.append(e)
+                    vals.append(v)
+                continue
+            kind1.append((r, fe))
+            r_idx.append(r)
+            f_idx.append(fe)
+            e_idx.append(0)
+            vals.append(value)
+
+    def _ix(pairs):
+        a = np.asarray(pairs, np.intp).reshape(-1, 2)
+        return a[:, 0], a[:, 1]
+
+    if nf:
+        meta['notfound'][_ix(nf)] = True
+    if ovf:
+        meta['overflow'][_ix(ovf)] = True
+    if kind1:
+        k1 = _ix(kind1)
+        meta['kind'][k1] = 1
+        meta['count'][k1] = 1
+    if kind2:
+        k2 = _ix(kind2)
+        meta['kind'][k2] = 2
+        meta['count'][k2] = np.asarray(counts, np.int32)
+    if vals:
+        lanes.encode_column(
+            (np.asarray(r_idx, np.intp), np.asarray(f_idx, np.intp),
+             np.asarray(e_idx, np.intp)),
+            vals, palette)

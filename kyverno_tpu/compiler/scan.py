@@ -78,12 +78,21 @@ def next_scanner_serial() -> int:
 
 _ENCODER_CPS: Optional['CompiledPolicySet'] = None
 _ENCODER_FORK_LOCK = __import__('threading').Lock()
+#: per-worker-process arena: keeps the columnar value palettes warm
+#: across the chunks a forked encoder serves (buffer pooling stays off
+#: in workers — tensors are pickled back after return, so a recycled
+#: buffer could be zeroed mid-serialization)
+_ENCODER_PALETTES = None
 
 
 def _encode_worker(args):
+    global _ENCODER_PALETTES
     docs, contexts, padded_n = args
+    if _ENCODER_PALETTES is None:
+        from .encode import LaneArena
+        _ENCODER_PALETTES = LaneArena(max_pool=0)
     batch = encode_batch(docs, _ENCODER_CPS, padded_n=padded_n,
-                         contexts=contexts)
+                         contexts=contexts, arena=_ENCODER_PALETTES)
     return batch.tensors()
 
 
@@ -260,6 +269,11 @@ class BatchScanner:
         self._policy_header = [
             (p, p.name, p.namespace, p.validation_failure_action,
              p.validation_failure_action_overrides) for p in policies]
+        # reusable encode buffers + cross-chunk value palettes for the
+        # streaming pipeline (compiler/encode.py LaneArena): chunk lane
+        # tensors recycle instead of reallocating ~100MB per chunk
+        from .encode import LaneArena
+        self._arena = LaneArena()
 
     def warmup(self, resources: Optional[List[dict]] = None) -> float:
         """Bring the admission-shape executable to serving readiness.
@@ -550,29 +564,40 @@ class BatchScanner:
     def _device_status_chunks(self, resources: List[dict],
                               contexts: Optional[List[dict]] = None,
                               match: Optional[np.ndarray] = None,
-                              adm_plan: Optional[Any] = None):
-        """Yield ``(start, status, detail, fdet, adm)`` per fixed-size
-        chunk; ``adm`` is the device's per-row admission-match decision
-        for the eligible program columns (None off the compact path or
-        when the policy set has none).
+                              adm_plan: Optional[Any] = None,
+                              match_fn=None):
+        """Yield ``(start, status, detail, fdet, adm, chunk_match)`` per
+        fixed-size chunk; ``adm`` is the device's per-row
+        admission-match decision for the eligible program columns (None
+        off the compact path or when the policy set has none).
 
-        Three-stage pipeline: an encode thread projects chunk i+2 onto the
-        slot table while a dispatch thread streams chunk i+1 to the device
-        and the caller (response assembly / aggregation) consumes chunk i
-        — end-to-end rate ≈ max(stage) instead of sum(stage).
+        The chunks stream through a bounded overlapped pipeline
+        (``compiler/pipeline.py``): encode → h2d → device_eval → d2h
+        each run on their own worker thread with at most
+        ``KTPU_PIPELINE_DEPTH`` chunks in flight, so end-to-end rate ≈
+        max(stage) instead of sum(stage) and a slow leg backpressures
+        intake instead of buffering.  Encode lane tensors are recycled
+        through the scanner's :class:`LaneArena` — a chunk's buffers
+        return to the pool when its d2h lands, so RSS stays flat in
+        ``n_resources``.
 
         ``match`` (the host-side [R, P] match mask) rides to the device
         with each chunk so fail details compact to the (matched, FAIL)
-        cells — d2h bytes drop ~3× over a remote-TPU tunnel."""
+        cells — d2h bytes drop ~3× over a remote-TPU tunnel.
+        ``match_fn(start, part)`` computes the mask per chunk inside
+        the encode stage instead (streaming callers avoid holding the
+        full [R, P] matrix)."""
         n = len(resources)
         if not self.cps.programs or not resources:
             z = np.zeros((n, len(self.cps.programs)), np.int8)
-            yield 0, z, z, z.astype(np.int32), None
+            zm = match[:n] if match is not None \
+                else np.zeros((n, len(self.cps.programs)), bool)
+            yield 0, z, z, z.astype(np.int32), None, zm
             return
-        from concurrent.futures import ThreadPoolExecutor
         from ..observability import device as devtel
         from ..observability import tracing
         from ..ops.eval import expand_compact, shard_batch
+        from .pipeline import ChunkPipeline
         chunk = self.CHUNK
         small = self.mesh is None and n <= self.SMALL_BATCH
         device = self._small_device() if small else None
@@ -583,26 +608,25 @@ class BatchScanner:
         # time to the right scan)
         tel_parent = tracing.current_span()
         tel_capture = devtel.current_capture()
+        arena = self._arena if self.mesh is None else None
 
         # multi-chunk scans encode in forked worker processes (off-GIL);
         # small scans stay in-process
         use_procs = n > chunk and self._encoder_pool.start()
 
         def inline_encode(part, part_ctx, bucket):
-            with devtel.stage('encode', {'rows': len(part)},
-                              parent=tel_parent):
+            with devtel.stage('encode', {'rows': len(part)}):
                 batch = encode_batch(part, self.cps, padded_n=bucket,
-                                     contexts=part_ctx)
-                return batch.tensors()
+                                     contexts=part_ctx, arena=arena)
+                return batch.tensors(), batch
 
-        def encode(start):
-            with devtel.install_capture(tel_capture):
-                return encode_work(start)
-
-        def encode_work(start):
+        def stage_encode(start):
             part = resources[start:start + chunk]
             part_ctx = contexts[start:start + chunk] \
                 if contexts is not None else None
+            cm = match[start:start + len(part)] if match is not None \
+                else (match_fn(start, part) if match_fn is not None
+                      else None)
             # canonical capacity padding (compiler/shapes.py): every
             # part pads to one of the few canonical row shapes and the
             # evaluator masks the tail rows via the __rowvalid__ lane,
@@ -613,48 +637,42 @@ class BatchScanner:
             # compile one extra shape on the accelerator backend.
             bucket = chunk if n > chunk else canonical_capacity(
                 len(part), chunk=chunk, small=self.SMALL_BATCH)
+            enc = batch = None
             if use_procs:
                 try:
-                    async_res = self._encoder_pool.submit(part, part_ctx,
-                                                          bucket)
-                    return (async_res, part, part_ctx, bucket), len(part)
+                    enc = self._encoder_pool.submit(part, part_ctx,
+                                                    bucket)
                 except Exception:  # noqa: BLE001 - fall back in-process
-                    pass
-            return inline_encode(part, part_ctx, bucket), len(part)
+                    enc = None
+            if enc is None:
+                enc, batch = inline_encode(part, part_ctx, bucket)
+            return {'start': start, 'ln': len(part), 'part': part,
+                    'part_ctx': part_ctx, 'bucket': bucket, 'enc': enc,
+                    'batch': batch, 'cm': cm}
 
-        def dispatch(enc_future, start):
-            # one wrapper span per chunk: entering it on the dispatch
-            # thread seeds the contextvar so the pack/h2d/compile/
-            # device_eval/d2h child spans (ops/eval.py + below) nest
-            # under it — and under the request trace via tel_parent;
-            # the provenance capture rides the same re-install
-            with devtel.install_capture(tel_capture), \
-                    tracing.tracer().start_span(
-                        'kyverno/device/chunk', {'chunk_start': start},
-                        parent=tel_parent):
-                return dispatch_work(enc_future, start)
-
-        def dispatch_work(enc_future, start):
-            tensors, ln = enc_future.result()
+        def stage_h2d(p):
+            start, ln = p['start'], p['ln']
+            tensors = p['enc']
             devtel.set_batch_size(ln)
             if not isinstance(tensors, dict):
                 # AsyncResult from the fork pool: a dead/OOM-killed worker
                 # never resolves its task, so bound the wait and redo the
                 # chunk in-process rather than wedging the whole scan
-                async_res, part, part_ctx, bucket = tensors
                 if self._encoder_pool._broken:
                     # pool already declared dead: don't wait another
                     # timeout per in-flight chunk
-                    tensors = inline_encode(part, part_ctx, bucket)
+                    tensors, p['batch'] = inline_encode(
+                        p['part'], p['part_ctx'], p['bucket'])
                 else:
                     try:
-                        tensors = async_res.get(
-                            timeout=self.ENCODE_TIMEOUT_S)
+                        tensors = tensors.get(timeout=self.ENCODE_TIMEOUT_S)
                     except Exception:  # noqa: BLE001 - worker death
                         self._encoder_pool.close()
                         self._encoder_pool._broken = True
-                        tensors = inline_encode(part, part_ctx, bucket)
-            if match is not None and self.mesh is None and tensors:
+                        tensors, p['batch'] = inline_encode(
+                            p['part'], p['part_ctx'], p['bucket'])
+            cm = p['cm']
+            if cm is not None and self.mesh is None and tensors:
                 from ..ops.eval import fold_match_unique
                 padded = next(iter(tensors.values())).shape[0]
                 # host-policy program columns are never read from fdet
@@ -662,8 +680,7 @@ class BatchScanner:
                 # FAIL cells out of the per-row compaction budget; the
                 # mask rides in UNIQUE-program space (duplicate columns
                 # OR-folded) so the device graph and d2h stay O(unique)
-                mm_p = (match[start:start + ln] &
-                        self._dev_mask).astype(np.uint8)
+                mm_p = (cm & self._dev_mask).astype(np.uint8)
                 mm_u = fold_match_unique(mm_p, self._evaluator)
                 mm = np.zeros((padded, mm_u.shape[1]), np.uint8)
                 mm[:ln] = mm_u
@@ -683,69 +700,80 @@ class BatchScanner:
                     tensors.update(admission_lanes.zero_lanes(
                         self._adm, padded))
             t, layout = shard_batch(tensors, self.mesh, device=device)
-            out = self._evaluator(t, layout)
-            if len(out) == 2:
-                # np.array COPIES: np.asarray of a host-backend jax
-                # array is zero-copy, and _free_inputs is about to
-                # release the backing buffers
+            p['enc'] = p['part'] = p['part_ctx'] = None
+            p['t'], p['layout'] = t, layout
+            return p
+
+        def stage_eval(p):
+            p['out'] = self._evaluator(p['t'], p['layout'])
+            return p
+
+        def stage_d2h(p):
+            start, ln, t, out = p['start'], p['ln'], p['t'], p['out']
+            try:
+                if len(out) == 2:
+                    # np.array COPIES: np.asarray of a host-backend jax
+                    # array is zero-copy, and _free_inputs is about to
+                    # release the backing buffers
+                    with devtel.d2h_guard({'chunk_start': start,
+                                           'rows': ln}) as g:
+                        o8 = np.array(out[0])
+                        o32 = np.array(out[1])
+                        g.add_d2h_bytes(o8.nbytes + o32.nbytes)
+                    s, d, fd, adm = expand_compact(o8, o32,
+                                                   self._evaluator)
+                    self._free_inputs(t, out)
+                    return (start, s[:ln], d[:ln], fd[:ln],
+                            adm[:ln] if adm is not None else None,
+                            p['cm'])
+                s, d, fd = out
+                if self.mesh is not None:
+                    import jax
+                    if jax.process_count() > 1:
+                        # multi-host mesh: each process only holds its
+                        # local shards of the batch axis — gather the
+                        # full matrices so every host assembles
+                        # identical reports (the reference replicates
+                        # this work per replica)
+                        from jax.experimental import multihost_utils
+                        s = multihost_utils.process_allgather(s, tiled=True)
+                        d = multihost_utils.process_allgather(d, tiled=True)
+                        fd = multihost_utils.process_allgather(fd,
+                                                               tiled=True)
                 with devtel.d2h_guard({'chunk_start': start,
                                        'rows': ln}) as g:
-                    o8 = np.array(out[0])
-                    o32 = np.array(out[1])
-                    g.add_d2h_bytes(o8.nbytes + o32.nbytes)
-                s, d, fd, adm = expand_compact(o8, o32, self._evaluator)
-                self._free_inputs(t, out)
-                return (s[:ln], d[:ln], fd[:ln],
-                        adm[:ln] if adm is not None else None)
-            s, d, fd = out
-            if self.mesh is not None:
-                import jax
-                if jax.process_count() > 1:
-                    # multi-host mesh: each process only holds its local
-                    # shards of the batch axis — gather the full
-                    # matrices so every host assembles identical reports
-                    # (the reference replicates this work per replica)
-                    from jax.experimental import multihost_utils
-                    s = multihost_utils.process_allgather(s, tiled=True)
-                    d = multihost_utils.process_allgather(d, tiled=True)
-                    fd = multihost_utils.process_allgather(fd, tiled=True)
-            with devtel.d2h_guard({'chunk_start': start,
-                                   'rows': ln}) as g:
-                s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
-                            np.array(fd)[:ln])
-                g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
-            if self.mesh is None:
-                self._free_inputs(t, out)
-            return s, d, fd, None
+                    s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
+                                np.array(fd)[:ln])
+                    g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
+                if self.mesh is None:
+                    self._free_inputs(t, out)
+                return start, s, d, fd, None, p['cm']
+            finally:
+                # the chunk's encode buffers return to the arena only
+                # after its device inputs are freed — a zero-copy h2d
+                # path can never observe a recycled buffer
+                if arena is not None and p.get('batch') is not None:
+                    arena.release(p['batch'])
 
         if n <= chunk:
-            # single-chunk fast path: thread-pool spawn/join costs more
-            # than it hides for one chunk (admission latency floor)
-            class _Now:
-                def __init__(self, v):
-                    self._v = v
-
-                def result(self):
-                    return self._v
-            yield (0, *dispatch(_Now(encode(0)), 0))
+            # single-chunk fast path: pipeline thread spawn/join costs
+            # more than it hides for one chunk (admission latency
+            # floor).  The chunk span closes BEFORE the yield — holding
+            # it across a yield would leak the current-span contextvar
+            # into the consumer
+            with devtel.install_capture(tel_capture), \
+                    tracing.tracer().start_span(
+                        'kyverno/device/chunk', {'chunk_start': 0},
+                        parent=tel_parent):
+                result = stage_d2h(stage_eval(stage_h2d(stage_encode(0))))
+            yield result
             return
 
-        from collections import deque
-        with ThreadPoolExecutor(max_workers=1) as enc_pool, \
-                ThreadPoolExecutor(max_workers=1) as disp_pool:
-            inflight: deque = deque()
-            for start in range(0, n, chunk):
-                inflight.append(
-                    (start,
-                     disp_pool.submit(dispatch,
-                                      enc_pool.submit(encode, start),
-                                      start)))
-                while len(inflight) > 2:
-                    s0, f = inflight.popleft()
-                    yield (s0, *f.result())
-            while inflight:
-                s0, f = inflight.popleft()
-                yield (s0, *f.result())
+        pipe = ChunkPipeline(
+            [('encode', stage_encode), ('h2d', stage_h2d),
+             ('device_eval', stage_eval), ('d2h', stage_d2h)],
+            capture=tel_capture, parent_span=tel_parent)
+        yield from pipe.run(range(0, n, chunk))
 
     def _device_statuses(self, resources: List[dict],
                          contexts: Optional[List[dict]] = None,
@@ -890,7 +918,7 @@ class BatchScanner:
                         {'chunk_start': start,
                          'programs': len(progs)}) as span:
                     try:
-                        start, status, detail, fdet, adm_out = \
+                        start, status, detail, fdet, adm_out, _cm = \
                             next(chunks)
                     except StopIteration:
                         return
@@ -1052,6 +1080,220 @@ class BatchScanner:
                 (coverage.REASON_POLICY_COUPLING, 'validate'))
             tally.host_rule(pol, rr.name, reason, path)
 
+    #: rows per incremental report-assembly window: each device chunk
+    #: assembles (and yields) in sub-windows of at most this many rows,
+    #: so the resident decoded-result footprint is bounded by the knob,
+    #: not the chunk capacity
+    REPORT_FLUSH_ROWS = int(__import__('os').environ.get(
+        'KTPU_REPORT_FLUSH_ROWS', '8192'))
+
+    def _report_order(self):
+        """Device programs in report-result sort order with their static
+        report fields: ``(j, prog, p_idx, policy_key, scored, category,
+        severity)``.  Report results sort on (policy key, rule name,
+        0, (), ts) and one scan shares one ts, so emitting columns in
+        this precomputed order yields each row's results already sorted
+        — no per-row sort on the streaming path (stable order matches
+        the unfused path's stable sort)."""
+        cached = getattr(self, '_report_order_cache', None)
+        if cached is None:
+            from ..reports.results import _policy_static
+            entries = []
+            for j, prog in self.device_programs:
+                policy = self.policies[prog.policy_index]
+                key, scored, category, severity = _policy_static(policy)
+                entries.append((key, prog.rule_name, j, prog,
+                                prog.policy_index, scored, category,
+                                severity))
+            entries.sort(key=lambda e: (e[0], e[1]))
+            cached = self._report_order_cache = [
+                (j, prog, p_idx, key, scored, category, severity)
+                for key, _rn, j, prog, p_idx, scored, category, severity
+                in entries]
+        return cached
+
+    _SUMMARY_BUCKETS = ('pass', 'fail', 'warn', 'error', 'skip')
+    _BUCKET_IDX = {b: i for i, b in enumerate(_SUMMARY_BUCKETS)}
+
+    def _assemble_report_window(self, resources, base, m, status, detail,
+                                fdet, sub_match, background_ok, ts,
+                                stamp, tally):
+        """Columnar assembly of one chunk window: per ordered program
+        column, group cells by (status, detail) and append the shared
+        flyweight result dict to each matched row — one result-dict
+        build per DISTINCT cell value, one numpy pass per column.
+        Returns (rows, row_policies, counts, dirty) where ``counts`` is
+        the [m, 5] summary matrix and ``dirty`` marks rows needing a
+        sort-merge (host-policy rows)."""
+        from ..reports.results import _rule_result
+        rows: List[list] = [[] for _ in range(m)]
+        row_pols: List[list] = [[] for _ in range(m)]
+        counts = np.zeros((m, 5), np.int32)
+        fly: Dict[Tuple, Any] = {}
+        bucket_idx = self._BUCKET_IDX
+        for j, prog, p_idx, key, scored, category, severity in \
+                self._report_order():
+            if not background_ok[j]:
+                continue
+            rows_j = np.flatnonzero(sub_match[:, j])
+            if rows_j.size == 0:
+                continue
+            if tally is not None:
+                tally.total_rows += int(rows_j.size)
+            st_col = status[rows_j, j].astype(np.int32)
+            det_col = detail[rows_j, j].astype(np.int32)
+            # context-loading programs keep the per-cell path: the load
+            # outcome depends on each resource's own context inputs
+            per_cell = prog.context_spec is not None
+            if per_cell:
+                groups = [(None, None, rows_j)]
+            else:
+                combined = st_col * 1024 + (det_col + 512)
+                uniq, inv = np.unique(combined, return_inverse=True)
+                groups = [(int(u) // 1024 , int(u) % 1024 - 512,
+                           rows_j[inv == gi])
+                          for gi, u in enumerate(uniq)]
+            for st, det, sub in groups:
+                if per_cell:
+                    # context programs check per resource: stay
+                    # row-at-a-time (memoized on context inputs)
+                    self._assemble_cells(
+                        prog, j, p_idx, key, scored, category, severity,
+                        sub, status, detail, fdet, resources, base, ts,
+                        stamp, fly, rows, row_pols, counts, tally)
+                    continue
+                if st == STATUS_FAIL:
+                    # FAIL messages hang off the per-row fail-detail
+                    # buffer — but the relevant fdet columns take few
+                    # distinct values, so group rows by them and
+                    # synthesize one message per distinct detail
+                    self._assemble_fail_groups(
+                        prog, j, p_idx, key, scored, category, severity,
+                        sub, fdet, resources, base, ts, stamp, fly,
+                        rows, row_pols, counts, tally)
+                    continue
+                cell_key = (j, st, det)
+                cell = fly.get(cell_key)
+                if cell is None:
+                    rr = self._synth_rule(prog, st, det, ts)
+                    if rr is _HOST_MARKER:
+                        cell = (_HOST_MARKER, 0)
+                    else:
+                        result = _rule_result(rr, key, scored, category,
+                                              severity, stamp, ts)
+                        cell = (result, bucket_idx[result['result']])
+                    fly[cell_key] = cell
+                result, bucket = cell
+                if result is _HOST_MARKER:
+                    if tally is not None:
+                        tally.fallback_n(
+                            prog, coverage.REASON_STATUS_HOST
+                            if st == STATUS_HOST
+                            else coverage.REASON_UNSYNTHESIZABLE,
+                            int(sub.size))
+                    for k in sub.tolist():
+                        rr = self._materialize(prog, resources[base + k])
+                        if rr is None:
+                            continue
+                        rr.timestamp = ts
+                        res = _rule_result(rr, key, scored, category,
+                                           severity, stamp, ts)
+                        rows[k].append(res)
+                        row_pols[k].append(p_idx)
+                        counts[k, bucket_idx[res['result']]] += 1
+                    continue
+                if tally is not None:
+                    tally.device_n(prog, int(sub.size))
+                for k in sub.tolist():
+                    rows[k].append(result)
+                    row_pols[k].append(p_idx)
+                counts[sub, bucket] += 1
+        return rows, row_pols, counts
+
+    def _assemble_fail_groups(self, prog, j, p_idx, key, scored,
+                              category, severity, sub, fdet, resources,
+                              base, ts, stamp, fly, rows, row_pols,
+                              counts, tally):
+        """Columnar FAIL assembly: rows group by the fail-detail
+        columns the message synthesis actually reads (column j, or the
+        anyPattern child block), one message per distinct detail."""
+        from ..reports.results import _rule_result
+        bucket_idx = self._BUCKET_IDX
+        meta = self._evaluator.any_meta.get(j) \
+            if prog.any_fail_sites is not None else None
+        if meta is None:
+            fds = fdet[sub, j]
+            uf, inv = np.unique(fds, return_inverse=True)
+            subgroups = [sub[inv == t] for t in range(uf.size)]
+        else:
+            p = len(self.cps.programs)
+            block = fdet[sub, p + meta[0]:p + meta[0] + meta[1]]
+            uf, inv = np.unique(block, axis=0, return_inverse=True)
+            subgroups = [sub[inv == t] for t in range(uf.shape[0])]
+        for sg in subgroups:
+            msg = self._fail_message_cached(prog, j, fdet[sg[0]])
+            if msg is None:
+                if tally is not None:
+                    tally.fallback_n(prog, coverage.REASON_UNSYNTHESIZABLE,
+                                     int(sg.size))
+                for k in sg.tolist():
+                    rr = self._materialize(prog, resources[base + k])
+                    if rr is None:
+                        continue
+                    rr.timestamp = ts
+                    res = _rule_result(rr, key, scored, category,
+                                       severity, stamp, ts)
+                    rows[k].append(res)
+                    row_pols[k].append(p_idx)
+                    counts[k, bucket_idx[res['result']]] += 1
+                continue
+            cell_key = (j, STATUS_FAIL, msg)
+            cell = fly.get(cell_key)
+            if cell is None:
+                rr = RuleResponse(prog.rule_name, RuleType.VALIDATION,
+                                  msg, RuleStatus.FAIL)
+                rr.timestamp = ts
+                result = _rule_result(rr, key, scored, category,
+                                      severity, stamp, ts)
+                cell = (result, bucket_idx[result['result']])
+                fly[cell_key] = cell
+            result, bucket = cell
+            if tally is not None:
+                tally.device_n(prog, int(sg.size))
+            for k in sg.tolist():
+                rows[k].append(result)
+                row_pols[k].append(p_idx)
+            counts[sg, bucket] += 1
+
+    def _assemble_cells(self, prog, j, p_idx, key, scored, category,
+                        severity, sub, status, detail, fdet, resources,
+                        base, ts, stamp, fly, rows, row_pols, counts,
+                        tally):
+        """Row-at-a-time assembly for the cells the columnar sweep
+        cannot group: FAIL messages (per-row fail details) and
+        context-loading programs (per-resource load outcomes)."""
+        from ..reports.results import _rule_result
+        bucket_idx = self._BUCKET_IDX
+        _HOST = _HOST_MARKER
+        for k in sub.tolist():
+            rr = self._cell(prog, j, int(status[k, j]), int(detail[k, j]),
+                            fdet[k], ts, fly, resources[base + k], tally)
+            if rr is _HOST:
+                rr = self._materialize(prog, resources[base + k])
+                if rr is not None:
+                    rr.timestamp = ts
+            if rr is None or rr is _HOST:
+                continue
+            result = _rule_result(rr, key, scored, category, severity,
+                                  stamp, ts)
+            rows[k].append(result)
+            row_pols[k].append(p_idx)
+            counts[k, bucket_idx[result['result']]] += 1
+        # _cell already incremented total_rows per cell — undo the
+        # double count from the column-level bulk add
+        if tally is not None:
+            tally.total_rows -= int(sub.size)
+
     def scan_report_results(self, resources: List[dict],
                             now: Optional[float] = None):
         """Yield ``(results, summary, policies)`` per resource — the
@@ -1062,119 +1304,111 @@ class BatchScanner:
         report results; bit-identity with the unfused path is pinned by
         tests/test_report_fusion.py).
 
+        Fully streaming: the per-chunk match mask is computed inside
+        the pipeline's encode stage (``match_fn``), verdict buffers are
+        consumed chunk-by-chunk as each d2h lands, and rows assemble
+        column-wise in ``KTPU_REPORT_FLUSH_ROWS`` windows — nothing is
+        ever materialized at ``n_resources`` scale.
+
         ``results`` are shared flyweight dicts (never mutate);
         ``policies`` is the list of Policy objects contributing at least
         one rule (for report policy labels)."""
-        from ..reports.results import (calculate_summary,
-                                       engine_response_to_report_results,
-                                       sort_report_results)
+        from ..reports.results import engine_response_to_report_results
         if not resources:
             return
         n = len(resources)
         now = time.time() if now is None else now
         ts = int(now)
+        ts_key = str(ts)
+        stamp = {'seconds': ts}
         self._ctx_ok_cache = {}
-        wrapped = [Resource(r) for r in resources]
-        match = self.match_matrix(resources, wrapped)
-        host_maybe = self._host_policy_maybe(resources, wrapped)
         progs = self.cps.programs
         background_ok = getattr(self, '_background_ok', None)
         if background_ok is None:
             background_ok = self._background_ok = np.array([
                 self.policies[p.policy_index].background for p in progs])
-        # result-dict flyweight per shared RuleResponse id (plus its
-        # precomputed sort key): one conversion per distinct cell value
-        result_of: Dict[int, Tuple[Any, dict, tuple]] = {}
 
-        def to_result(rr, p_idx):
-            rid = id(rr)
-            hit = result_of.get(rid)
-            if hit is not None and hit[0] is rr:
-                return hit[1], hit[2]
-            from ..reports.results import _policy_static, _rule_result
-            policy = self.policies[p_idx]
-            key, scored, category, severity = _policy_static(policy)
-            result = _rule_result(rr, key, scored, category, severity,
-                                  {'seconds': ts}, ts)
-            sort_key = (result.get('policy', ''), result.get('rule', ''),
-                        0, (), str(ts))
-            result_of[rid] = (rr, result, sort_key)
-            return result, sort_key
+        def match_fn(start, part):
+            # runs inside the pipeline's encode stage: the full [R, P]
+            # mask and Resource list never exist
+            return self.match_matrix(part, [Resource(r) for r in part])
 
-        chunks = self._device_status_chunks(resources, None, match)
+        chunks = self._device_status_chunks(resources, None,
+                                            match_fn=match_fn)
         tally = coverage.scan_tally()
-        start = 0
+        flush = max(1, self.REPORT_FLUSH_ROWS)
+        host_idx = [p_idx for p_idx in self._host_policy_idx
+                    if self._policy_header[p_idx][0].background]
+        done = 0
         try:
-            while start < n:
+            while done < n:
                 try:
-                    start, status, detail, fdet, _adm = next(chunks)
+                    start, status, detail, fdet, _adm, cm = next(chunks)
                 except StopIteration:
                     return
                 m = status.shape[0]
-                sub_match = match[start:start + m]
-                fly: Dict[Tuple, Any] = {}
-                rows: List[list] = [[] for _ in range(m)]
-                row_policies: List[set] = [set() for _ in range(m)]
+                host_maybe = None
+                part_docs = resources[start:start + m]
+                if host_idx:
+                    part_wrapped = [Resource(r) for r in part_docs]
+                    host_maybe = self._host_policy_maybe(part_docs,
+                                                         part_wrapped)
                 from ..observability import device as devtel
-                with devtel.stage('report', {'rows': m}) as rstage:
-                    for j, prog in self.device_programs:
-                        if not background_ok[j]:
-                            continue
-                        rows_j = np.flatnonzero(sub_match[:, j])
-                        if rows_j.size == 0:
-                            continue
-                        p_idx = prog.policy_index
-                        st_col = status[rows_j, j].tolist()
-                        det_col = detail[rows_j, j].tolist()
-                        for k, st, det in zip(rows_j.tolist(), st_col,
-                                              det_col):
-                            rr = self._cell(prog, j, st, det, fdet[k],
-                                            ts, fly, resources[start + k],
-                                            tally)
-                            if rr is _HOST_MARKER:
-                                rr = self._materialize(
-                                    prog, resources[start + k])
-                                if rr is not None:
-                                    rr.timestamp = ts
-                            if rr is None:
-                                continue
-                            result, sort_key = to_result(rr, p_idx)
-                            rows[k].append((sort_key, result))
-                            row_policies[k].add(p_idx)
-                    if tally is not None:
-                        ratio = tally.ratio()
-                        if ratio is not None:
-                            rstage.set_attribute('device_coverage_ratio',
-                                                 round(ratio, 4))
-                for k in range(m):
-                    i = start + k
-                    res_doc = resources[i]
-                    entries = rows[k]
-                    for p_idx in self._host_policy_idx:
-                        if not self._policy_header[p_idx][0].background:
-                            continue
-                        if host_maybe[p_idx] is not None and \
-                                not host_maybe[p_idx][i]:
-                            continue
-                        resp = self._host_run(p_idx, res_doc)
+                for w0 in range(0, m, flush):
+                    w1 = min(w0 + flush, m)
+                    wm = w1 - w0
+                    with devtel.stage('report', {'rows': wm}) as rstage:
+                        rows, row_pols, counts = \
+                            self._assemble_report_window(
+                                resources, start + w0, wm,
+                                status[w0:w1], detail[w0:w1],
+                                fdet[w0:w1], cm[w0:w1], background_ok,
+                                ts, stamp, tally)
                         if tally is not None:
-                            self._tally_host_policy(tally, p_idx, resp)
-                        if not resp.policy_response.rules:
-                            continue
-                        row_policies[k].add(p_idx)
-                        for result in engine_response_to_report_results(
-                                resp, now=ts):
-                            entries.append((
-                                (result.get('policy', ''),
-                                 result.get('rule', ''), 0, (), str(ts)),
-                                result))
-                    entries.sort(key=lambda e: e[0])
-                    results = [r for _sk, r in entries]
-                    summary = calculate_summary(results)
-                    yield (results, summary,
-                           [self.policies[p]
-                            for p in sorted(row_policies[k])])
-                start += m
+                            ratio = tally.ratio()
+                            if ratio is not None:
+                                rstage.set_attribute(
+                                    'device_coverage_ratio',
+                                    round(ratio, 4))
+                    for k in range(wm):
+                        i = start + w0 + k
+                        results = rows[k]
+                        pols = row_pols[k]
+                        dirty = False
+                        for p_idx in host_idx:
+                            if host_maybe[p_idx] is not None and \
+                                    not host_maybe[p_idx][w0 + k]:
+                                continue
+                            resp = self._host_run(p_idx, resources[i])
+                            if tally is not None:
+                                self._tally_host_policy(tally, p_idx,
+                                                        resp)
+                            if not resp.policy_response.rules:
+                                continue
+                            pols.append(p_idx)
+                            dirty = True
+                            for result in \
+                                    engine_response_to_report_results(
+                                        resp, now=ts):
+                                results.append(result)
+                                counts[k, self._BUCKET_IDX[
+                                    result['result']]] += 1
+                        if dirty:
+                            # host-policy results interleave by sort
+                            # key; device results arrived pre-sorted,
+                            # so only these rows pay a sort-merge
+                            results.sort(key=lambda r: (
+                                r.get('policy', ''), r.get('rule', ''),
+                                0, (), ts_key))
+                        c = counts[k]
+                        summary = {
+                            'pass': int(c[0]), 'fail': int(c[1]),
+                            'warn': int(c[2]), 'error': int(c[3]),
+                            'skip': int(c[4])}
+                        seen: Dict[int, None] = dict.fromkeys(pols)
+                        yield (results, summary,
+                               [self.policies[p] for p in sorted(seen)])
+                done += m
         finally:
             if tally is not None:
                 tally.finish()

@@ -52,6 +52,14 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_d2h_stalls_total': Metric(
         'counter', 'Readbacks exceeding the stall watchdog threshold '
         '(KTPU_D2H_STALL_S, default 30s).'),
+    'kyverno_tpu_scan_pipeline_inflight_chunks': Metric(
+        'gauge', 'Chunks resident in the streaming scan pipeline '
+        '(bounded by KTPU_PIPELINE_DEPTH; intake backpressures at the '
+        'bound instead of buffering).'),
+    'kyverno_tpu_scan_backpressure_seconds_total': Metric(
+        'counter', 'Time a scan-pipeline stage spent blocked on a full '
+        'downstream queue (stage=intake|encode|h2d|device_eval|d2h) — '
+        'which leg bounds the stream.'),
     # device-coverage ledger (observability/coverage.py)
     'kyverno_tpu_rule_placement_info': Metric(
         'gauge', '1 per compiled (policy, rule, path); placement=device|'

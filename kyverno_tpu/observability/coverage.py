@@ -171,10 +171,29 @@ class ScanTally:
         key = (prog.policy_name, prog.rule_name, self._path(prog))
         self.rule_device[key] = self.rule_device.get(key, 0) + 1
 
+    def device_n(self, prog, n: int) -> None:
+        """``n`` device-synthesized cells of one program at once — the
+        columnar report assembly accounts whole status groups per
+        vectorized column sweep instead of per cell."""
+        self.device_rows += n
+        key = (prog.policy_name, prog.rule_name, self._path(prog))
+        self.rule_device[key] = self.rule_device.get(key, 0) + n
+
     def fallback(self, prog, reason: str) -> None:
         """One host-replayed cell of a device-compiled program."""
         self._host(prog.policy_name, prog.rule_name, self._path(prog),
                    reason)
+
+    def fallback_n(self, prog, reason: str, n: int) -> None:
+        """``n`` host-replayed cells of one program at once."""
+        if reason not in REASONS:
+            reason = 'unknown'
+        self.host_rows += n
+        path = self._path(prog)
+        rkey = (path, reason)
+        self.by_reason[rkey] = self.by_reason.get(rkey, 0) + n
+        hkey = (prog.policy_name, prog.rule_name, path, reason)
+        self.rule_host[hkey] = self.rule_host.get(hkey, 0) + n
 
     def host_rule(self, policy: str, rule: str, reason: str,
                   path: str = 'validate') -> None:

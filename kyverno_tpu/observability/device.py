@@ -37,6 +37,8 @@ COMPILE_CACHE_REQUESTS = 'kyverno_tpu_compile_cache_requests_total'
 DEVICE_BATCH_SIZE = 'kyverno_tpu_device_batch_size'
 D2H_BYTES = 'kyverno_tpu_d2h_bytes_total'
 D2H_STALLS = 'kyverno_tpu_d2h_stalls_total'
+PIPELINE_INFLIGHT = 'kyverno_tpu_scan_pipeline_inflight_chunks'
+BACKPRESSURE = 'kyverno_tpu_scan_backpressure_seconds_total'
 
 #: canonical stage labels, in pipeline order
 STAGES = ('pack', 'encode', 'h2d', 'compile', 'device_eval', 'd2h',
@@ -268,6 +270,21 @@ def set_batch_size(n: int) -> None:
 def add_d2h_bytes(n: int) -> None:
     if _registry is not None and n:
         _registry.inc(D2H_BYTES, float(n))
+
+
+def set_pipeline_inflight(n: int) -> None:
+    """Chunks currently resident in the streaming scan pipeline
+    (bounded by KTPU_PIPELINE_DEPTH; reset to 0 when a scan ends)."""
+    if _registry is not None:
+        _registry.set_gauge(PIPELINE_INFLIGHT, float(n))
+
+
+def add_backpressure(stage: str, seconds: float) -> None:
+    """Time a pipeline stage spent blocked handing its chunk to a full
+    downstream queue (or the intake waiting for a free chunk slot) —
+    the direct measure of which leg bounds the stream."""
+    if _registry is not None and seconds > 0:
+        _registry.inc(BACKPRESSURE, float(seconds), stage=stage)
 
 
 # -- d2h stall watchdog -----------------------------------------------------

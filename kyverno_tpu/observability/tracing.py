@@ -321,3 +321,32 @@ def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
 
 def current_span():
     return _current_span.get()
+
+
+class _SpanScope:
+    """Make an existing span the ambient parent on this thread without
+    touching its lifecycle (the owner still ends it)."""
+
+    __slots__ = ('span', '_token')
+
+    def __init__(self, span: Optional[Span]):
+        self.span = span
+        self._token = None
+
+    def __enter__(self):
+        if self.span is not None:
+            self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current_span.reset(self._token)
+        return False
+
+
+def install_span(span: Optional[Span]) -> _SpanScope:
+    """Context manager parenting this thread's new spans under ``span``
+    (no-op for None).  Pipeline worker threads install the scan's
+    request span so every stage span joins one trace — the span itself
+    is neither entered nor ended here."""
+    return _SpanScope(span)

@@ -224,6 +224,23 @@ class BackgroundScanController:
         with self._lock:
             self._pending.update(e['uid'] for e in self.cache.entries())
 
+    def _pending_rows(self, pending, epoch):
+        """Yield ``(uid, resource, hash, digest)`` for each pending uid
+        that actually needs work — a generator, so the cache-hit pass
+        streams entries one at a time instead of double-materializing a
+        1M-entry MetadataCache into parallel row lists before the
+        replay/miss partition."""
+        for uid in pending:
+            entry = self.cache.get(uid)
+            if entry is None:
+                continue
+            prior = self._scanned.get(uid)
+            if prior is not None and prior[0] == entry['hash'] and \
+                    prior[1] >= epoch:
+                continue  # resumability: already scanned this version
+            yield (uid, entry['resource'], entry['hash'],
+                   entry.get('digest') or spec_digest(entry['resource']))
+
     def reconcile(self, now: Optional[float] = None) -> List[dict]:
         """Drain the pending set through the verdict-cache filter and
         one batched device scan of the misses, writing
@@ -234,27 +251,9 @@ class BackgroundScanController:
             pending = list(self._pending)
             self._pending.clear()
             epoch = self._policy_epoch
-        work: List[dict] = []
-        uids: List[str] = []
-        hashes: List[str] = []   # metadata-cache hashes, reused below
-        digests: List[str] = []  # verdict-cache keys, ditto
-        for uid in pending:
-            entry = self.cache.get(uid)
-            if entry is None:
-                continue
-            prior = self._scanned.get(uid)
-            if prior is not None and prior[0] == entry['hash'] and \
-                    prior[1] >= epoch:
-                continue  # resumability: already scanned this version
-            work.append(entry['resource'])
-            uids.append(uid)
-            hashes.append(entry['hash'])
-            digests.append(entry.get('digest') or
-                           spec_digest(entry['resource']))
-        if not work:
-            return []
         now = time.time() if now is None else now
         from ..observability import provenance, tracing
+        from ..observability import device as devtel
         from ..verdictcache import publish_tick
         # decision provenance: every rescan row yields one record —
         # cache_replay (digest, zero device share), batch (dense-scan
@@ -270,16 +269,24 @@ class BackgroundScanController:
         vc = self.verdict_cache \
             if self._verdicts_cacheable and not exceptions else None
         reports: List[dict] = []
+        rows = self._pending_rows(pending, epoch)
+        try:
+            first = next(rows)
+        except StopIteration:
+            return []
+        import itertools
+        rows = itertools.chain([first], rows)
         with tracing.start_span('kyverno/rescan', {
-                'rows_pending': len(work),
                 'cache': 'on' if vc is not None else 'off'}) as span:
             if exceptions:
-                stream = self._host_scan(work, exceptions)
-                for uid, resource, rhash, responses in zip(
-                        uids, work, hashes, stream):
+                n_work = 0
+                for uid, resource, rhash, digest in rows:
+                    n_work += 1
                     t_row = time.monotonic() if prov_on else 0.0
-                    report = self._store_report(uid, resource, responses,
-                                                now, rhash)
+                    report = self._store_report(
+                        uid, resource,
+                        self._host_scan_row(resource, exceptions),
+                        now, rhash)
                     self._scanned[uid] = (rhash, now)
                     if report is not None:
                         reports.append(report)
@@ -287,11 +294,13 @@ class BackgroundScanController:
                         self._record_row(
                             provenance, 'host_fallback', uid, resource,
                             duration_s=time.monotonic() - t_row)
-                self._tick_stats(span, publish_tick, len(work),
-                                 scanned=len(work), replayed=0)
+                self._tick_stats(span, publish_tick, n_work,
+                                 scanned=n_work, replayed=0)
                 return reports
-            # verdict-cache filter stage: replay hit rows in O(1),
-            # ship only changed digests to the device
+            # verdict-cache filter stage, single streaming pass: hit
+            # rows replay (and write their report) the moment they are
+            # seen — only the misses (O(churn)) accumulate for the
+            # batched device scan
             ts = int(now)
             miss_uids: List[str] = []
             miss_work: List[dict] = []
@@ -299,8 +308,7 @@ class BackgroundScanController:
             miss_hashes: List[str] = []
             replayed = 0
             if vc is not None:
-                for uid, resource, rhash, digest in zip(
-                        uids, work, hashes, digests):
+                for uid, resource, rhash, digest in rows:
                     row = vc.lookup(digest)
                     if row is None:
                         miss_uids.append(uid)
@@ -322,15 +330,19 @@ class BackgroundScanController:
                             duration_s=time.monotonic() - t_row,
                             verdict_digest=digest)
             else:
-                miss_uids, miss_work, miss_hashes = uids, work, hashes
-                miss_digests = [''] * len(work)
+                for uid, resource, rhash, digest in rows:
+                    miss_uids.append(uid)
+                    miss_work.append(resource)
+                    miss_digests.append(digest)
+                    miss_hashes.append(rhash)
             # fused fast path over the misses: report results assembled
             # straight from the device cells (bit-identity pinned by
             # tests/test_report_fusion), rows written back to the cache
             if miss_work:
-                from ..observability import device as devtel
-                cap = devtel.ScanCapture() if prov_on else None
-                t_scan = time.monotonic() if prov_on else 0.0
+                # the capture feeds both provenance (device-share
+                # amortization) and the tick's overlap attribution
+                cap = devtel.ScanCapture()
+                t_scan = time.monotonic()
                 with devtel.install_capture(cap):
                     for uid, resource, digest, rhash, row in zip(
                             miss_uids, miss_work, miss_digests,
@@ -347,6 +359,13 @@ class BackgroundScanController:
                             vc.store(digest, uid, results, summary,
                                      [self._policy_index[id(p)]
                                       for p in row_policies])
+                # per-stage busy time ÷ tick wall: >1 means the
+                # pipeline legs genuinely overlapped this tick
+                scan_wall = time.monotonic() - t_scan
+                if scan_wall > 0:
+                    busy = sum(cap.stages.values())
+                    span.set_attribute('overlap_ratio',
+                                       round(busy / scan_wall, 4))
                 if prov_on:
                     # dense-scanned rows are riders of one shared tick
                     # scan: the tick's device_eval time amortizes over
@@ -364,7 +383,8 @@ class BackgroundScanController:
                             device_eval_s=device_eval_s,
                             aot_cache=cap.aot,
                             coverage_ratio=cap.coverage_ratio)
-            self._tick_stats(span, publish_tick, len(work),
+            self._tick_stats(span, publish_tick,
+                             len(miss_work) + replayed,
                              scanned=len(miss_work), replayed=replayed)
         if vc is not None:
             vc.flush()
@@ -395,17 +415,17 @@ class BackgroundScanController:
                             now: float,
                             resource_hash: Optional[str] = None
                             ) -> Optional[dict]:
-        from .results import set_fused_results
+        from .types import build_fused_report
         results, summary, row_policies = row
         meta = resource.get('metadata') or {}
         ns = meta.get('namespace', '')
-        report = new_background_scan_report(resource)
+        report = build_fused_report(resource, results, summary,
+                                    row_policies)
         if not report['metadata'].get('name'):
             report['metadata']['name'] = uid.replace('/', '-').lower()
         set_resource_version_labels(report, resource, resource_hash)
-        report.setdefault('metadata', {}).setdefault('annotations', {})[
+        report['metadata'].setdefault('annotations', {})[
             ANNOTATION_LAST_SCAN_TIME] = _rfc3339(now)
-        set_fused_results(report, results, summary, row_policies)
         return self._write_report(report, ns)
 
     def _write_report(self, report: dict, ns: str) -> Optional[dict]:
@@ -450,16 +470,15 @@ class BackgroundScanController:
                 pass
         return out
 
-    def _host_scan(self, work: List[dict], exceptions: List[dict]):
+    def _host_scan_row(self, doc: dict, exceptions: List[dict]):
         from ..engine.api import PolicyContext
-        for doc in work:
-            responses = []
-            for policy in self.policies:
-                pctx = PolicyContext(policy, new_resource=doc,
-                                     exceptions=exceptions)
-                responses.append(
-                    self.engine.apply_background_checks(pctx))
-            yield responses
+        responses = []
+        for policy in self.policies:
+            pctx = PolicyContext(policy, new_resource=doc,
+                                 exceptions=exceptions)
+            responses.append(
+                self.engine.apply_background_checks(pctx))
+        return responses
 
     def _store_report(self, uid: str, resource: dict, responses,
                       now: float, resource_hash: Optional[str] = None

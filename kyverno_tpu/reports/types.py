@@ -172,6 +172,56 @@ def new_background_scan_report(resource: dict) -> dict:
     return report
 
 
+# label-dict template per distinct policy tuple: the streaming report
+# path stamps the same policy set onto every row's report, so the
+# managed-by + per-policy labels prebuild once and each report pays one
+# C-level dict copy (id-keyed with identity re-verification, like
+# _POLICY_LABEL_CACHE)
+_FUSED_LABEL_CACHE: dict = {}
+
+
+def _fused_labels(policies) -> dict:
+    lkey = tuple(id(p) for p in policies)
+    hit = _FUSED_LABEL_CACHE.get(lkey)
+    if hit is not None and len(hit[0]) == len(policies) and \
+            all(a is b for a, b in zip(hit[0], policies)):
+        return hit[1]
+    labels = {LABEL_APP_MANAGED_BY: VALUE_KYVERNO_APP}
+    for policy in policies:
+        label, rv = _policy_label_rv(policy)
+        labels[label] = rv
+    if len(_FUSED_LABEL_CACHE) > 4096:
+        _FUSED_LABEL_CACHE.clear()
+    _FUSED_LABEL_CACHE[lkey] = (tuple(policies), labels)
+    return labels
+
+
+def build_fused_report(resource: dict, results: List[dict], summary: dict,
+                       policies) -> dict:
+    """One-shot BackgroundScanReport for the streaming scan path:
+    equivalent to ``new_background_scan_report`` + ``set_policy_label``
+    per policy + ``set_fused_results``, built as a single literal with
+    the label dict copied from a per-policy-set template — the report
+    materialization leg of the 1M-row stream runs ~3x fewer dict
+    operations per row."""
+    meta = resource.get('metadata') or {}
+    namespace = meta.get('namespace', '')
+    report_meta = {
+        'name': meta.get('uid', '') or meta.get('name', ''),
+        'ownerReferences': [_owner_reference(resource)],
+    }
+    if namespace:
+        report_meta['namespace'] = namespace
+    report_meta['labels'] = dict(_fused_labels(policies))
+    return {
+        'apiVersion': 'kyverno.io/v1alpha2',
+        'kind': 'BackgroundScanReport' if namespace
+                else 'ClusterBackgroundScanReport',
+        'metadata': report_meta,
+        'spec': {'results': list(results), 'summary': dict(summary)},
+    }
+
+
 def new_policy_report(namespace: str, name: str,
                       results: Optional[List[dict]] = None) -> dict:
     """reference: new.go:57 NewPolicyReport"""

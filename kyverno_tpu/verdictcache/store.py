@@ -233,9 +233,22 @@ class VerdictCache:
         """Row → the ``(results, summary, row_policies)`` triple
         ``scan_report_results`` would yield, stamped with ``ts`` (all
         results of one fused row share the tick's timestamp, so sort
-        order is unaffected)."""
-        stamp = {'seconds': ts}
-        results = [dict(r, timestamp=stamp) for r in row['r']]
+        order is unaffected).
+
+        Re-stamping is lazy: the stamped form is written back onto the
+        row with the tick second it carries, so replays within the same
+        second (fast reconcile loops over a large cache) return the
+        shared dicts with zero per-result copies.  Stamped results are
+        immutable from then on — a later tick with a different second
+        builds fresh copies, never mutating what an earlier report may
+        still reference."""
+        if row.get('t') == ts:
+            results = row['r']
+        else:
+            stamp = {'seconds': ts}
+            results = [dict(r, timestamp=stamp) for r in row['r']]
+            row['r'] = results
+            row['t'] = ts
         return (results, dict(row['s']),
                 [policies[p] for p in row['p'] if p < len(policies)])
 

@@ -210,6 +210,75 @@ def test_ktpu204_positive_negative(tmp_path):
     assert not rep.active
 
 
+def test_ktpu205_positive_negative(tmp_path):
+    # per-row context dicts in the encode entry itself: flagged
+    rep = run(tmp_path, {'a.py': """\
+    def encode_batch(docs, cps):
+        bases = [{'request': {'object': d}} for d in docs]
+        return bases
+    """}, rules=['KTPU205'])
+    assert rule_ids(rep) == {'KTPU205'}
+    # one-level callee on the hot path: flagged (dict() and deepcopy
+    # and json.dumps all count)
+    rep = run(tmp_path, {'a.py': """\
+    import copy
+    import json
+
+    def _ctx_rows(docs):
+        out = []
+        for d in docs:
+            out.append(copy.deepcopy(d))
+            out.append(json.dumps(d))
+        return out
+
+    def encode_mutate_batch(docs, program, padded_n=0):
+        return _ctx_rows(docs)
+    """}, rules=['KTPU205'])
+    assert rule_ids(rep) == {'KTPU205'}
+    assert len(rep.active) == 2
+    # allocation hoisted out of the loop: clean
+    rep = run(tmp_path, {'a.py': """\
+    def encode_batch(docs, cps):
+        shared = {'request': {'object': None}}
+        out = []
+        for d in docs:
+            shared['request']['object'] = d
+            out.append(len(shared))
+        return out
+    """}, rules=['KTPU205'])
+    assert not rep.active
+    # dict-in-loop in a function NOT reachable from an encode entry
+    rep = run(tmp_path, {'a.py': """\
+    def encode_batch(docs, cps):
+        return len(docs)
+
+    def unrelated(docs):
+        return [{'k': d} for d in docs]
+    """}, rules=['KTPU205'])
+    assert not rep.active
+    # two-level call chains are out of scope (one-level resolution,
+    # like KTPU204)
+    rep = run(tmp_path, {'a.py': """\
+    def _deep(docs):
+        return [{'k': d} for d in docs]
+
+    def _mid(docs):
+        return _deep(docs)
+
+    def encode_batch(docs, cps):
+        return _mid(docs)
+    """}, rules=['KTPU205'])
+    assert not rep.active
+    # suppression with a reason works like every other rule
+    rep = run(tmp_path, {'a.py': """\
+    def encode_batch(docs, cps):
+        # ktpu: noqa[KTPU205] -- test fixture: deliberate per-row dict
+        return [{'request': {'object': d}} for d in docs]
+    """}, rules=['KTPU205'])
+    assert not rep.active
+    assert len(rep.suppressed) == 1
+
+
 # -- KTPU3xx: fallback taxonomy ----------------------------------------------
 
 def test_ktpu301_positive_negative(tmp_path):
@@ -582,9 +651,9 @@ def test_baseline_survives_line_drift(tmp_path):
 
 def test_rule_registry_complete():
     expected = {'KTPU001', 'KTPU002', 'KTPU101', 'KTPU102', 'KTPU103',
-                'KTPU201', 'KTPU202', 'KTPU203', 'KTPU204', 'KTPU301',
-                'KTPU302', 'KTPU303', 'KTPU401', 'KTPU402', 'KTPU501',
-                'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505'}
+                'KTPU201', 'KTPU202', 'KTPU203', 'KTPU204', 'KTPU205',
+                'KTPU301', 'KTPU302', 'KTPU303', 'KTPU401', 'KTPU402',
+                'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
