@@ -164,6 +164,16 @@ WARM_EXECUTABLES_MAX = 2
 #: batch-of-one, so this committed floor is what keeps it fixed.
 HET_OCCUPANCY_FLOOR = 2.0
 
+#: chaos ratchets for ``bench.py --admission-chaos`` (graceful
+#: degradation under injected faults — kyverno_tpu/faults/): every
+#: response across every chaos wave must be HTTP 200 with a verdict
+#: bit-identical to the fault-free oracle, the ``poison_row`` shed
+#: count must equal EXACTLY the number of injected poison rows (the
+#: quarantine isolates rows, it does not shed batch-sized groups), and
+#: the tripped circuit breaker must complete the open → half-open →
+#: closed round trip visible on /debug/breakers and the state gauge.
+CHAOS_MAX_NON_200 = 0
+
 _IMAGES = ['nginx:1.25.3', 'nginx:latest', 'ghcr.io/org/app:v2.1',
            'redis:7', 'docker.io/library/busybox', 'gcr.io/proj/svc:prod',
            'app', 'registry.internal:5000/team/api:canary']
@@ -1467,6 +1477,300 @@ def admission_heterogeneous(ctx, thread_counts=None,
 
 
 # --------------------------------------------------------------------------
+# Chaos block: graceful degradation under injected faults.  Three
+# synthetic-cluster waves run against the batch-mode serving chain with
+# KTPU_FAULTS armed (marker-poisoned rows that kill any shared dispatch
+# carrying them), bracketed by policy churn mid-stream, then a breaker
+# drill trips the policy set's circuit and drives the open → half-open
+# → closed round trip.  Every response is replayed against a fault-free
+# sequential oracle: the committed ratchets are zero non-200s, verdict
+# bit-identity, and shed(poison_row) == EXACTLY the injected poison
+# rows (isolation, not batch-sized collateral).
+
+
+def admission_chaos(ctx, threads: int = 6,
+                    requests_per_thread: int = 8) -> dict:
+    import copy
+    import threading
+    from kyverno_tpu import faults
+    from kyverno_tpu.api.policy import Policy as _Policy
+    from kyverno_tpu.conformance.loadgen import SyntheticCluster
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.policycache import cache as pcache
+    from kyverno_tpu.serving import breaker as breaker_mod
+
+    server, handlers, _n_replicated, device_served = ctx
+    cluster = SyntheticCluster(seed=4321, poison_ratio=1 / 8)
+    exc_docs = cluster.exception_docs()
+    prior_mode = handlers.serving_mode
+    handlers.serving_mode = 'batch'
+    pc_builder = handlers.pc_builder
+    prior_build = pc_builder.build
+
+    def build(request, policy=None):
+        pctx = prior_build(request, policy)
+        ui = request.get('userInfo') or {}
+        if cluster.is_exception_tenant(ui.get('username', '')):
+            # exception churn: verdict-neutral placeholder exceptions
+            # keep a tenant slice on the host loop mid-chaos
+            pctx.exceptions = list(exc_docs)
+        return pctx
+
+    pc_builder.build = build
+    total = threads * requests_per_thread
+    batcher = handlers._get_batcher()
+    ns0 = cluster.namespaces[0]
+
+    def enforce_policies():
+        return handlers.cache.get_policies(pcache.VALIDATE_ENFORCE,
+                                           'Pod', ns0)
+
+    def send(i):
+        body, status = server.handle_request('/validate/fail',
+                                             cluster.review_bytes(i))
+        return status, json.loads(body.decode('utf-8')).get('response')
+
+    def run_wave(start):
+        out = [None] * total
+        barrier = threading.Barrier(threads + 1)
+
+        def work(tid):
+            barrier.wait()
+            # strided partition (thread tid serves k ≡ tid mod threads):
+            # poison rows land mid-stream of several threads instead of
+            # piling up as every thread's final request, so dispatches
+            # mix poison with healthy riders the way real traffic does
+            for j in range(requests_per_thread):
+                k = tid + j * threads
+                out[k] = send(start + k)
+
+        workers = [threading.Thread(target=work, args=(tid,))
+                   for tid in range(threads)]
+        for t in workers:
+            t.start()
+        barrier.wait()
+        for t in workers:
+            t.join()
+        return out
+
+    def shed_counts():
+        return dict(batcher.stats()['shed'])
+
+    def check(name, got, start, expect_poison=None, before=None):
+        non200 = sum(1 for s, _r in got if s != 200)
+        mismatched = sum(1 for k, (_s, r) in enumerate(got)
+                         if r != oracle[start + k])
+        block = {'wave': name, 'requests': len(got), 'non_200': non200,
+                 'verdict_mismatches': mismatched}
+        if non200 > CHAOS_MAX_NON_200:
+            raise AssertionError(
+                f'chaos wave {name}: {non200} non-200 responses — '
+                f'degradation must never surface as an error')
+        if mismatched:
+            raise AssertionError(
+                f'chaos wave {name}: {mismatched} verdicts diverged '
+                f'from the fault-free oracle')
+        if expect_poison is not None:
+            after = shed_counts()
+            got_poison = after.get('poison_row', 0) - \
+                before.get('poison_row', 0)
+            block['poison_rows_injected'] = expect_poison
+            block['poison_rows_shed'] = got_poison
+            if got_poison != expect_poison:
+                raise AssertionError(
+                    f'chaos wave {name}: shed(poison_row)={got_poison} '
+                    f'!= injected poison rows {expect_poison} — '
+                    f'quarantine must isolate rows, not groups')
+        result['waves'].append(block)
+        _progress(f'chaos wave {name}: non_200={non200} '
+                  f'mismatches={mismatched} '
+                  + (f'poison {block["poison_rows_shed"]}/'
+                     f'{expect_poison}' if expect_poison is not None
+                     else ''))
+
+    result: dict = {'device_served': device_served, 'waves': [],
+                    'ratchet_checked': bool(device_served)}
+    recovery_n = 8
+    try:
+        # fault-free oracle: same requests, sequential, no injection
+        faults.disable()
+        oracle = {}
+        for i in range(3 * total + recovery_n):
+            status, resp = send(i)
+            if status != 200:
+                raise AssertionError(
+                    f'oracle request {i} returned HTTP {status}')
+            oracle[i] = resp
+        if not device_served:
+            # without a compiled scanner nothing dispatches, so the
+            # fault sites never arm: report, don't pretend
+            return result
+
+        # wave A: poison markers under concurrency
+        faults.configure(cluster.fault_spec())
+        before = shed_counts()
+        got = run_wave(0)
+        check('A:poison', got, 0,
+              expect_poison=cluster.poison_count(total), before=before)
+
+        # policy churn mid-stream: byte-identical docs re-put as fresh
+        # Policy objects — new id()-tuple batch key, scanner rebuild
+        # (the AOT content-hash cache serves the compile) — wave B
+        # flows DURING the rebuild and host-serves without a single
+        # non-200 or verdict change
+        fresh = [_Policy(copy.deepcopy(p.raw))
+                 for p in enforce_policies()]
+        handlers.cache.warm_up(fresh)
+        got = run_wave(total)
+        check('B:churn', got, total)
+
+        # wave C: rebuild settled, poison isolation must be exact again
+        handlers.wait_device_ready(enforce_policies(), timeout=float(
+            os.environ.get('BENCH_ADMISSION_WAIT_S', '90')))
+        before = shed_counts()
+        got = run_wave(2 * total)
+        check('C:poison-after-churn', got, 2 * total,
+              expect_poison=cluster.poison_count(total, start=2 * total),
+              before=before)
+
+        # breaker drill: six nth batcher_dispatch faults = three
+        # dispatch failures (original + quarantine solo retry each),
+        # tripping the set's breaker; requests then shed breaker_open;
+        # after the backoff a single probe recovers the device path
+        result['breaker'] = _chaos_breaker_drill(
+            server, handlers, cluster, oracle, 3 * total,
+            enforce_policies, breaker_mod, shed_counts, send,
+            global_registry())
+        return result
+    finally:
+        faults.disable()
+        pc_builder.build = prior_build
+        handlers.serving_mode = prior_mode
+
+
+def _chaos_breaker_drill(server, handlers, cluster, oracle, base,
+                         enforce_policies, breaker_mod, shed_counts,
+                         send, registry) -> dict:
+    from kyverno_tpu import faults
+    policies = enforce_policies()
+    key = handlers._policy_key(policies)
+    handlers.wait_device_ready(policies, timeout=float(
+        os.environ.get('BENCH_ADMISSION_WAIT_S', '90')))
+    drill: dict = {'states': []}
+
+    def note(stage):
+        state = handlers._breakers.state(key)
+        drill['states'].append({'stage': stage, 'state': state})
+        return state
+
+    # clean entry: one healthy dispatch pops any wave-residue breaker
+    # entry and zeroes the consecutive-failure strike count, so the
+    # drill's trip arithmetic starts from a known state
+    i = base
+    status, resp = send(i)
+    if status != 200 or resp != oracle[i]:
+        raise AssertionError('breaker drill warm-up request failed')
+    i += 1
+    if note('entry') != breaker_mod.CLOSED:
+        raise AssertionError('breaker not closed entering the drill')
+    # trip sequence: each request's dispatch fails twice (original +
+    # quarantine solo retry) with a retry-exhausted error — wholesale
+    # evidence, so every request counts ONE breaker failure; each
+    # failure drops the scanner, so wait for the rebuild between
+    # failures to keep the dispatches flowing.  Requests still answer
+    # 200 with the oracle verdict via the host loop throughout.
+    faults.configure(';'.join(
+        f'site={faults.SITE_BATCHER_DISPATCH},nth={n},exhaust=1'
+        for n in range(1, 2 * handlers.DEVICE_FAILURE_LIMIT + 1)))
+    def breaker_failures():
+        for row in handlers._breakers.report():
+            if row['key'] == repr(key):
+                return row['failures']
+        return 0
+
+    for k in range(handlers.DEVICE_FAILURE_LIMIT):
+        status, resp = send(i)
+        if status != 200 or resp != oracle[i]:
+            raise AssertionError(
+                f'breaker drill trip request {k} degraded wrong: '
+                f'status={status}')
+        i += 1
+        # the rider sheds (and send() returns) before the batcher
+        # thread delivers its failure verdict; the scanner pop happens
+        # before the count ticks, so once the count reads k+1 the next
+        # wait_device_ready is guaranteed to see the rebuild
+        poll_deadline = time.time() + 10.0
+        while breaker_failures() < k + 1 and time.time() < poll_deadline:
+            time.sleep(0.01)
+        if breaker_failures() < k + 1:
+            raise AssertionError(
+                f'breaker drill trip request {k} never recorded its '
+                f'device failure')
+        if k + 1 < handlers.DEVICE_FAILURE_LIMIT:
+            handlers.wait_device_ready(policies, timeout=float(
+                os.environ.get('BENCH_ADMISSION_WAIT_S', '90')))
+    faults.disable()
+    if note('tripped') != breaker_mod.OPEN:
+        raise AssertionError(
+            'three dispatch failures did not open the breaker')
+    report = breaker_mod.debug_report()
+    if not any(row['state'] == breaker_mod.OPEN
+               for row in report['breakers']):
+        raise AssertionError('/debug/breakers shows no open breaker '
+                             'after the trip')
+    if registry is not None:
+        drill['open_gauge'] = registry.gauge_value(
+            breaker_mod.BREAKER_STATE, state=breaker_mod.OPEN)
+        if drill['open_gauge'] < 1:
+            raise AssertionError('breaker_state{state="open"} gauge '
+                                 'did not register the trip')
+    # while open: requests shed breaker_open and host-serve
+    before = shed_counts()
+    status, resp = send(i)
+    if status != 200 or resp != oracle[i]:
+        raise AssertionError('open-breaker request degraded wrong')
+    i += 1
+    after = shed_counts()
+    drill['breaker_open_sheds'] = after.get('breaker_open', 0) - \
+        before.get('breaker_open', 0)
+    if drill['breaker_open_sheds'] < 1:
+        raise AssertionError('no breaker_open shed was recorded while '
+                             'the breaker was open')
+    # recovery: sleep past the backoff, let the half-open probe spawn
+    # the rebuild, then ride it to a recorded success
+    entry_backoff = max((row.get('reopens_in_s', 0.0)
+                         for row in report['breakers']), default=0.0)
+    time.sleep(entry_backoff + 0.1)
+    status, resp = send(i)  # grants the probe; spawns the rebuild
+    if status != 200 or resp != oracle[i]:
+        raise AssertionError('half-open probe request degraded wrong')
+    i += 1
+    if not handlers.wait_device_ready(policies, timeout=float(
+            os.environ.get('BENCH_ADMISSION_WAIT_S', '90'))):
+        raise AssertionError('device path did not rebuild during the '
+                             'half-open window')
+    note('half_open')
+    status, resp = send(i)  # the probe that closes the breaker
+    if status != 200 or resp != oracle[i]:
+        raise AssertionError('recovery request degraded wrong')
+    i += 1
+    deadline = time.time() + 10.0
+    while handlers._breakers.state(key) != breaker_mod.CLOSED and \
+            time.time() < deadline:
+        time.sleep(0.02)
+    if note('recovered') != breaker_mod.CLOSED:
+        raise AssertionError(
+            'probe success did not close the breaker (no recovery)')
+    if registry is not None and registry.gauge_value(
+            breaker_mod.BREAKER_STATE, state=breaker_mod.OPEN) > 0:
+        raise AssertionError('breaker_state{state="open"} gauge still '
+                             'non-zero after recovery')
+    chain = ' -> '.join(s['state'] for s in drill['states'])
+    _progress(f'chaos breaker drill: {chain}')
+    return drill
+
+
+# --------------------------------------------------------------------------
 # Rescan churn bench: the O(churn) claim for the digest-keyed verdict
 # cache (kyverno_tpu/verdictcache/).  Steady state: every tick demands a
 # full report rebuild over N rows of which only churn_ratio changed —
@@ -1838,6 +2142,32 @@ def admission_concurrency_main(platform: str) -> int:
     return 0
 
 
+def admission_chaos_main(platform: str) -> int:
+    """``bench.py --admission-chaos``: run only the chaos block —
+    synthetic-cluster waves under injected faults plus the breaker
+    round-trip drill (CI-sized; scale the policy set with
+    BENCH_CHAOS_POLICIES)."""
+    import random
+    # CI-sized breaker backoff: the drill sleeps through one open
+    # window on purpose, so the default 1s base would dominate the
+    # bench wall clock; explicit env still wins
+    os.environ.setdefault('KTPU_BREAKER_BACKOFF_MS', '300')
+    policies = load_policy_pack()
+    rng = random.Random(42)
+    pods = [make_pod(rng, i) for i in range(256)]
+    target = int(os.environ.get('BENCH_CHAOS_POLICIES', '200'))
+    _progress(f'admission chaos chain @{target} policies')
+    ctx = _admission_server(policies, pods, target_policies=target)
+    block = admission_chaos(ctx)
+    ctx[1].shutdown()
+    print(json.dumps({
+        'metric': 'admission_chaos', 'platform': platform,
+        'n_policies': ctx[2], 'device_served': ctx[3],
+        'admission_chaos': block,
+    }))
+    return 0
+
+
 def main() -> int:
     # the BASELINE.md north star is a 1M-Pod background scan; BENCH_N
     # caps the pods, BENCH_BUDGET_S caps the measured streaming time —
@@ -1880,6 +2210,16 @@ def main() -> int:
             traceback.print_exc()
             print(json.dumps({
                 'metric': 'admission_concurrency', 'platform': platform,
+                'error': f'{type(e).__name__}: {e}'}))
+            return 1
+    if '--admission-chaos' in sys.argv[1:]:
+        try:
+            return admission_chaos_main(platform)
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': 'admission_chaos', 'platform': platform,
                 'error': f'{type(e).__name__}: {e}'}))
             return 1
     if '--warm-probe' in sys.argv[1:]:

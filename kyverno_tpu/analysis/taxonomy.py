@@ -18,6 +18,11 @@ Both are program-structure properties — enforced here, statically.
   the ledger.
 * **KTPU303** — dead reason: a taxonomy member no site ever raises
   (mirrors the dead-metric pass).
+* **KTPU304** — a broad ``except Exception`` in a serving-path file
+  (any ``serving/`` component, or a ``pipeline.py``) that neither
+  re-raises nor records a shed/fallback reason: the never-500
+  discipline says every swallowed serving error must land on a ledger
+  somewhere, or degradation becomes silent.
 """
 
 from __future__ import annotations
@@ -268,3 +273,55 @@ def _check_dead_reasons(ctx: Context) -> Iterable[Finding]:
             f'taxonomy reason {slug!r} ({const}) has no raise/record '
             f'site — remove it or wire the fallback that should '
             f'carry it')
+
+
+#: calls inside an ``except Exception`` handler that prove the
+#: failure was attributed instead of silently swallowed: shed-ledger
+#: records, coverage records, and the batcher's quarantine entry
+#: points (which shed transitively per isolated row)
+SHED_CALLS = {'shed', '_try_shed', 'record', 'record_shed',
+              'record_fallback', '_shed_batch', '_quarantine'}
+
+
+def _is_broad_except(node: ast.ExceptHandler) -> bool:
+    names = []
+    if node.type is None:
+        return True  # bare except:
+    for leaf in ast.walk(node.type):
+        if isinstance(leaf, ast.Name):
+            names.append(leaf.id)
+        elif isinstance(leaf, ast.Attribute):
+            names.append(leaf.attr)
+    return 'Exception' in names or 'BaseException' in names
+
+
+def _handler_attributes(node: ast.ExceptHandler) -> bool:
+    for stmt in node.body:
+        for leaf in ast.walk(stmt):
+            if isinstance(leaf, ast.Raise):
+                return True
+            if isinstance(leaf, ast.Call) and \
+                    _callee_name(leaf.func) in SHED_CALLS:
+                return True
+    return False
+
+
+@register('KTPU304', 'serving-path `except Exception` that neither '
+                     'records a shed reason nor re-raises')
+def _check_swallowed_serving_errors(ctx: Context) -> Iterable[Finding]:
+    graph = jit_graph(ctx)
+    for rel, mi in graph.modules.items():
+        parts = rel.replace(os.sep, '/').split('/')
+        if 'serving' not in parts and parts[-1] != 'pipeline.py':
+            continue
+        for node in ast.walk(mi.sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_except(node) or _handler_attributes(node):
+                continue
+            yield mi.sf.finding(
+                'KTPU304', node,
+                'broad except on the serving path neither re-raises '
+                'nor records a shed/fallback reason — a swallowed '
+                'serving error is silent degradation; attribute it '
+                'via the shed ledger or coverage.record_fallback()')

@@ -155,11 +155,15 @@ def load_executable(key: str, store: Optional[AotStore] = None) -> Any:
     ``aot_load_rejected_total`` so the next process recompiles instead
     of re-failing (or worse, SIGILLing mid-request)."""
     from jax.experimental import serialize_executable as se
+    from .. import faults
     store = store or default_store()
     blob = store.load(key)
     if blob is None:
         return None
     try:
+        # injected aot_load faults exercise the real rejection path: a
+        # load that dies mid-decode counts a rejection and recompiles
+        faults.check(faults.SITE_AOT_LOAD)
         payload, in_tree, out_tree, meta = _unpack_blob(blob)
     except Exception:  # noqa: BLE001 - stale/corrupt entry: recompile
         _reject(store, key, 'undecodable')

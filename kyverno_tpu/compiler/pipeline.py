@@ -17,6 +17,15 @@ time lands on ``kyverno_tpu_scan_backpressure_seconds_total{stage}``,
 and the number of resident chunks is exported as the
 ``kyverno_tpu_scan_pipeline_inflight_chunks`` gauge.  Items leave the
 pipeline in submission order (single worker per stage, FIFO queues).
+
+Failure model: a transient stage error is retried per chunk
+(``KTPU_STAGE_RETRIES`` attempts beyond the first, exponential
+backoff) before surfacing at the consumer; an error that burns the
+whole budget is marked ``ktpu_retry_exhausted`` and attributed on the
+coverage ledger.  Whenever a chunk dies — terminal stage error, or the
+stream aborting with chunks still in flight — the ``cleanup`` hook
+runs on that chunk's current value, so owners of pooled buffers (the
+scanner's encode arena) reclaim them instead of leaking per crash.
 """
 
 from __future__ import annotations
@@ -37,6 +46,22 @@ def pipeline_depth(default: int = 2) -> int:
         return default
 
 
+def stage_retries(default: int = 1) -> int:
+    """Retry attempts per (chunk, stage) beyond the first
+    (``KTPU_STAGE_RETRIES``, min 0)."""
+    try:
+        return max(0, int(os.environ.get('KTPU_STAGE_RETRIES',
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+#: backoff before retry attempt k is ``_RETRY_BACKOFF_S * 2**(k-1)`` —
+#: enough for a transient device hiccup to clear, far below the shed
+#: deadline of any batched rider waiting on the scan
+_RETRY_BACKOFF_S = 0.005
+
+
 class _Item:
     __slots__ = ('value', 'error')
 
@@ -55,16 +80,23 @@ class ChunkPipeline:
     the previous stage's value to the next.  :meth:`run` is a generator
     yielding the final values in submission order; a stage exception
     surfaces at the consumer for the item that failed (later items
-    still flow).  Closing the generator early stops intake and drains
-    the workers — no thread outlives the ``run`` call."""
+    still flow), after ``retries`` transparent re-runs of the failing
+    stage on that chunk.  Closing the generator early stops intake and
+    drains the workers — no thread outlives the ``run`` call, and
+    ``cleanup(value)`` runs for every chunk that errored or was still
+    in flight when the stream ended."""
 
     def __init__(self, stages: Sequence[Tuple[str, Callable[[Any], Any]]],
                  depth: Optional[int] = None, capture=None,
-                 parent_span=None):
+                 parent_span=None,
+                 cleanup: Optional[Callable[[Any], None]] = None,
+                 retries: Optional[int] = None):
         self.stages = list(stages)
         self.depth = depth if depth is not None else pipeline_depth()
         self.capture = capture
         self.parent_span = parent_span
+        self.cleanup = cleanup
+        self.retries = retries if retries is not None else stage_retries()
         self._queues: List[queue.Queue] = \
             [queue.Queue(maxsize=1) for _ in self.stages]
         self._out: queue.Queue = queue.Queue()
@@ -94,6 +126,52 @@ class ChunkPipeline:
         q.put(item)
         devtel.add_backpressure(stage, time.monotonic() - t0)
 
+    def _cleanup(self, value: Any) -> None:
+        """Best-effort owner cleanup for a chunk that will never reach
+        the consumer (terminal stage error or an aborted stream)."""
+        if self.cleanup is None or value is None:
+            return
+        try:
+            self.cleanup(value)
+        except Exception:  # ktpu: noqa[KTPU304] -- best-effort buffer
+            pass           # reclaim; the chunk's own error already surfaced
+
+    def _run_stage(self, name: str, fn: Callable[[Any], Any],
+                   item) -> None:
+        """Apply one stage to one chunk with the per-chunk retry
+        budget; a terminal failure records the exhaustion, releases
+        the chunk's buffers, and parks the error on the item for the
+        consumer."""
+        attempt = 0
+        while True:
+            try:
+                item.value = fn(item.value)
+                return
+            except BaseException as e:  # noqa: BLE001 - surfaces
+                attempt += 1            # at the consumer
+                # only plain Exceptions are retry candidates —
+                # KeyboardInterrupt/SystemExit must surface immediately
+                if attempt <= self.retries and isinstance(e, Exception) \
+                        and not self._stop.is_set():
+                    time.sleep(_RETRY_BACKOFF_S * (2.0 ** (attempt - 1)))
+                    continue
+                if attempt > 1:
+                    # the whole retry budget burned: mark the error so
+                    # shed accounting downstream (batcher quarantine)
+                    # can attribute it, and count the attributed fall
+                    from ..observability import coverage
+                    try:
+                        e.ktpu_retry_exhausted = True
+                        e.ktpu_stage = name
+                    except Exception:  # ktpu: noqa[KTPU304] -- exotic
+                        pass           # exception sans __dict__
+                    coverage.record_fallback(
+                        'serving', coverage.REASON_STAGE_RETRY_EXHAUSTED)
+                item.error = e
+                self._cleanup(item.value)
+                item.value = None
+                return
+
     # -- workers ------------------------------------------------------------
 
     def _worker(self, i: int) -> None:
@@ -113,11 +191,7 @@ class ChunkPipeline:
                     qout.put(item)
                     return
                 if item.error is None and not self._stop.is_set():
-                    try:
-                        item.value = fn(item.value)
-                    except BaseException as e:  # noqa: BLE001 - surfaces
-                        item.error = e          # at the consumer
-                        item.value = None
+                    self._run_stage(name, fn, item)
                 self._put(qout, name, item)
 
     def _feed(self, items: Iterable) -> None:
@@ -167,6 +241,21 @@ class ChunkPipeline:
             feeder.join(timeout=5)
             for t in threads:
                 t.join(timeout=5)
+            # drain: chunks still parked in the stage queues when the
+            # stream ended (consumer raised / generator closed / stage
+            # crash) never reach an owner — reclaim their buffers here
+            # so an aborted scan leaks nothing
+            for q in list(self._queues) + [self._out]:
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is _SENTINEL or not isinstance(item, _Item):
+                        continue
+                    if item.error is None:
+                        self._cleanup(item.value)
+                        item.value = None
             from ..observability import device as devtel
             with self._inflight_lock:
                 self._inflight = 0

@@ -32,6 +32,7 @@ from ..engine.api import (EngineResponse, PolicyContext, RuleResponse,
 from ..engine.engine import Engine
 from ..engine.match import matches_resource_description
 from ..observability import coverage
+from .. import faults
 from . import admission as admission_lanes
 from .compile import compile_policies
 from .encode import encode_batch
@@ -620,7 +621,23 @@ class BatchScanner:
                                      contexts=part_ctx, arena=arena)
                 return batch.tensors(), batch
 
+        def release_chunk(p):
+            """Return a chunk's encode buffers to the arena exactly
+            once — after d2h frees its device inputs on the success
+            path, or via the pipeline's cleanup hook when the chunk
+            dies mid-flight (stage crash, aborted stream).  Device
+            references are dropped first so a zero-copy h2d path never
+            sees its backing buffer recycled while still reachable."""
+            if not isinstance(p, dict):
+                return
+            p['t'] = p['out'] = p['enc'] = None
+            batch = p.get('batch')
+            p['batch'] = None
+            if arena is not None and batch is not None:
+                arena.release(batch)
+
         def stage_encode(start):
+            faults.check(faults.SITE_ENCODE)
             part = resources[start:start + chunk]
             part_ctx = contexts[start:start + chunk] \
                 if contexts is not None else None
@@ -651,6 +668,7 @@ class BatchScanner:
                     'batch': batch, 'cm': cm}
 
         def stage_h2d(p):
+            faults.check(faults.SITE_H2D)
             start, ln = p['start'], p['ln']
             tensors = p['enc']
             devtel.set_batch_size(ln)
@@ -705,55 +723,53 @@ class BatchScanner:
             return p
 
         def stage_eval(p):
+            faults.check(faults.SITE_DEVICE_EVAL)
             p['out'] = self._evaluator(p['t'], p['layout'])
             return p
 
         def stage_d2h(p):
+            faults.check(faults.SITE_D2H)
             start, ln, t, out = p['start'], p['ln'], p['t'], p['out']
-            try:
-                if len(out) == 2:
-                    # np.array COPIES: np.asarray of a host-backend jax
-                    # array is zero-copy, and _free_inputs is about to
-                    # release the backing buffers
-                    with devtel.d2h_guard({'chunk_start': start,
-                                           'rows': ln}) as g:
-                        o8 = np.array(out[0])
-                        o32 = np.array(out[1])
-                        g.add_d2h_bytes(o8.nbytes + o32.nbytes)
-                    s, d, fd, adm = expand_compact(o8, o32,
-                                                   self._evaluator)
-                    self._free_inputs(t, out)
-                    return (start, s[:ln], d[:ln], fd[:ln],
-                            adm[:ln] if adm is not None else None,
-                            p['cm'])
-                s, d, fd = out
-                if self.mesh is not None:
-                    import jax
-                    if jax.process_count() > 1:
-                        # multi-host mesh: each process only holds its
-                        # local shards of the batch axis — gather the
-                        # full matrices so every host assembles
-                        # identical reports (the reference replicates
-                        # this work per replica)
-                        from jax.experimental import multihost_utils
-                        s = multihost_utils.process_allgather(s, tiled=True)
-                        d = multihost_utils.process_allgather(d, tiled=True)
-                        fd = multihost_utils.process_allgather(fd,
-                                                               tiled=True)
+            if len(out) == 2:
+                # np.array COPIES: np.asarray of a host-backend jax
+                # array is zero-copy, and _free_inputs is about to
+                # release the backing buffers
                 with devtel.d2h_guard({'chunk_start': start,
                                        'rows': ln}) as g:
-                    s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
-                                np.array(fd)[:ln])
-                    g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
-                if self.mesh is None:
-                    self._free_inputs(t, out)
-                return start, s, d, fd, None, p['cm']
-            finally:
-                # the chunk's encode buffers return to the arena only
-                # after its device inputs are freed — a zero-copy h2d
-                # path can never observe a recycled buffer
-                if arena is not None and p.get('batch') is not None:
-                    arena.release(p['batch'])
+                    o8 = np.array(out[0])
+                    o32 = np.array(out[1])
+                    g.add_d2h_bytes(o8.nbytes + o32.nbytes)
+                s, d, fd, adm = expand_compact(o8, o32,
+                                               self._evaluator)
+                self._free_inputs(t, out)
+                cm = p['cm']
+                release_chunk(p)
+                return (start, s[:ln], d[:ln], fd[:ln],
+                        adm[:ln] if adm is not None else None, cm)
+            s, d, fd = out
+            if self.mesh is not None:
+                import jax
+                if jax.process_count() > 1:
+                    # multi-host mesh: each process only holds its
+                    # local shards of the batch axis — gather the
+                    # full matrices so every host assembles
+                    # identical reports (the reference replicates
+                    # this work per replica)
+                    from jax.experimental import multihost_utils
+                    s = multihost_utils.process_allgather(s, tiled=True)
+                    d = multihost_utils.process_allgather(d, tiled=True)
+                    fd = multihost_utils.process_allgather(fd,
+                                                           tiled=True)
+            with devtel.d2h_guard({'chunk_start': start,
+                                   'rows': ln}) as g:
+                s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],
+                            np.array(fd)[:ln])
+                g.add_d2h_bytes(s.nbytes + d.nbytes + fd.nbytes)
+            if self.mesh is None:
+                self._free_inputs(t, out)
+            cm = p['cm']
+            release_chunk(p)
+            return start, s, d, fd, None, cm
 
         if n <= chunk:
             # single-chunk fast path: pipeline thread spawn/join costs
@@ -765,14 +781,24 @@ class BatchScanner:
                     tracing.tracer().start_span(
                         'kyverno/device/chunk', {'chunk_start': 0},
                         parent=tel_parent):
-                result = stage_d2h(stage_eval(stage_h2d(stage_encode(0))))
+                p = None
+                try:
+                    p = stage_encode(0)
+                    result = stage_d2h(stage_eval(stage_h2d(p)))
+                except BaseException:
+                    # the inline path has no pipeline cleanup hook: a
+                    # stage crash must still hand the chunk's encode
+                    # buffers back before the error surfaces
+                    release_chunk(p)
+                    raise
             yield result
             return
 
         pipe = ChunkPipeline(
             [('encode', stage_encode), ('h2d', stage_h2d),
              ('device_eval', stage_eval), ('d2h', stage_d2h)],
-            capture=tel_capture, parent_span=tel_parent)
+            capture=tel_capture, parent_span=tel_parent,
+            cleanup=release_chunk)
         yield from pipe.run(range(0, n, chunk))
 
     def _device_statuses(self, resources: List[dict],

@@ -15,7 +15,13 @@ Consumers:
   :meth:`SyntheticCluster.review_bytes` and ratchets mean batch
   occupancy under this traffic (``HET_OCCUPANCY_FLOOR``);
 * tests use small instances to pin batched-vs-sync bit-identity under
-  mixed admission tuples.
+  mixed admission tuples;
+* the chaos drills (``bench.py --admission-chaos``,
+  ``tests/test_faults.py``) mark a deterministic slice of rows as
+  *poison* — their ``chaos`` label is what a marker-armed
+  ``KTPU_FAULTS`` clause keys on — and pair the traffic with a fault
+  schedule, so a run under injected failures replays against its own
+  fault-free oracle.
 
 Layered beside the kuttl/scenario harness (this package): scenarios
 replay *recorded* cases, the generator synthesizes *load*.
@@ -26,6 +32,12 @@ from __future__ import annotations
 import bisect
 import json
 from typing import Dict, Iterator, List, Optional, Tuple
+
+
+#: label value a poison row carries under ``metadata.labels.chaos`` —
+#: the key the fault injector's ``marker=`` clauses match on
+#: (``kyverno_tpu.faults.MARKER_LABEL``); inert in a fault-free run
+POISON_MARKER = 'poison'
 
 
 def _zipf_cum(n: int, s: float) -> List[float]:
@@ -52,7 +64,8 @@ class SyntheticCluster:
                  zipf_s: float = 1.1, update_ratio: float = 0.25,
                  delete_ratio: float = 0.0,
                  exception_tenant_ratio: float = 0.05,
-                 compliant_ratio: float = 0.5):
+                 compliant_ratio: float = 0.5,
+                 poison_ratio: float = 0.0):
         import random
         self.seed = seed
         self._base = random.Random(seed)
@@ -71,6 +84,16 @@ class SyntheticCluster:
         self.exception_users = frozenset(
             u for i, u in enumerate(self.users)
             if step and i % step == step - 1)
+        # poison rows: every poison_step-th request carries the chaos
+        # marker label AND is forced onto a non-exception tenant with a
+        # device-served verb, so every poison row is guaranteed to ride
+        # the batched device path — the quarantine ratchet can then
+        # demand shed(poison_row) == the exact injected poison count
+        self._poison_step = max(1, int(round(1.0 / poison_ratio))) \
+            if poison_ratio > 0 else 0
+        self._device_users = [u for u in self.users
+                              if u not in self.exception_users] \
+            or list(self.users)
 
     # -- per-index draws ---------------------------------------------------
 
@@ -93,6 +116,27 @@ class SyntheticCluster:
     def is_exception_tenant(self, username: str) -> bool:
         return username in self.exception_users
 
+    # -- poison rows (chaos drills) ----------------------------------------
+
+    def is_poison(self, i: int) -> bool:
+        """Whether the i-th request is a marked poison row (pure in
+        ``(poison_ratio, i)`` — callers compute exact expectations)."""
+        step = self._poison_step
+        return bool(step) and i % step == step - 1
+
+    def poison_count(self, count: int, start: int = 0) -> int:
+        """Poison rows among requests ``start .. start+count-1``."""
+        return sum(1 for k in range(count) if self.is_poison(start + k))
+
+    def fault_spec(self, error: str = 'RuntimeError') -> str:
+        """``KTPU_FAULTS`` clause arming the poison marker: any batched
+        device dispatch carrying a marked row raises ``error`` — the
+        batcher's bisection then has a row-deterministic failure to
+        isolate (the clause re-fires on every sub-batch that still
+        contains the poison row, and never on one that does not)."""
+        return f'site=batcher_dispatch,marker={POISON_MARKER}' \
+               f',error={error}'
+
     def pod(self, ns: str, name: str, user: str,
             compliant: bool) -> Dict:
         idx = int(user.rsplit('-', 1)[1])
@@ -113,15 +157,22 @@ class SyntheticCluster:
         user = self._pick(rng, self.users, self._user_cum)
         ns = self._pick(rng, self.namespaces, self._ns_cum)
         compliant = rng.random() < self.compliant_ratio
+        poison = self.is_poison(i)
+        if poison:
+            # device-path guarantee: never an exception tenant (whose
+            # requests bypass the batcher entirely)
+            user = self._device_users[i % len(self._device_users)]
         name = f'pod-{i}'
         doc = self.pod(ns, name, user, compliant)
+        if poison:
+            doc['metadata']['labels']['chaos'] = POISON_MARKER
         verb_draw = rng.random()
-        if verb_draw < self.delete_ratio:
+        if poison or verb_draw >= self.delete_ratio + self.update_ratio:
+            operation = 'CREATE'  # poison rows keep a device verb
+        elif verb_draw < self.delete_ratio:
             operation = 'DELETE'
-        elif verb_draw < self.delete_ratio + self.update_ratio:
-            operation = 'UPDATE'
         else:
-            operation = 'CREATE'
+            operation = 'UPDATE'
         req = {
             'uid': f'load-{self.seed}-{i}',
             'operation': operation,

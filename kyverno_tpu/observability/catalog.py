@@ -90,7 +90,19 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_admission_shed_total': Metric(
         'counter', 'Requests shed from the batched fast path to the '
         'host engine loop, by reason=queue_full|deadline|scan_error|'
-        'shutdown (never a 500).'),
+        'shutdown|poison_row|breaker_open|stage_retry_exhausted '
+        '(never a 500).'),
+    # degradation under failure (faults/, serving/breaker.py)
+    'kyverno_tpu_faults_injected_total': Metric(
+        'counter', 'Faults the KTPU_FAULTS injection harness actually '
+        'raised, by site= (chaos drills only; zero in production).'),
+    'kyverno_tpu_breaker_state': Metric(
+        'gauge', 'Per-policy-set circuit breakers in each lifecycle '
+        'state, by state=closed|open|half_open (serving/breaker.py).'),
+    'kyverno_tpu_breaker_evictions_total': Metric(
+        'counter', 'Breaker entries evicted by the KTPU_BREAKER_CAP '
+        'bound; forgetting breaker state can silently re-admit a '
+        'broken backend, so evictions are counted, never silent.'),
     # verdict cache + incremental rescans (verdictcache/)
     'kyverno_tpu_verdict_cache_hits_total': Metric(
         'counter', 'Background-rescan rows replayed from the '

@@ -84,6 +84,17 @@ REASON_ADMISSION_UNENCODABLE = 'admission_unencodable'  # a request's
 #   match runs on the host matcher; path="serving" counts batcher
 #   tickets keyed on the whole canonical tuple because their scanner
 #   cannot consume per-row admissions
+# Degradation under failure (serving/batcher.py quarantine,
+# serving/breaker.py lifecycle, compiler/pipeline.py retries):
+REASON_POISON_ROW = 'poison_row'  # quarantine bisection isolated this
+#   row as the one poisoning its shared dispatch — the host loop
+#   serves it while its healthy batch riders stayed on device
+REASON_BREAKER_OPEN = 'breaker_open'  # the policy set's circuit
+#   breaker is open (or half-open with the probe slot taken): the
+#   request host-serves without touching the device path
+REASON_STAGE_RETRY_EXHAUSTED = 'stage_retry_exhausted'  # a scan
+#   pipeline stage kept failing after its whole KTPU_STAGE_RETRIES
+#   budget; the chunk's error surfaced to the consumer
 
 REASONS = frozenset({
     REASON_UNSUPPORTED_OPERATOR, REASON_HOST_CLOSURE, REASON_API_CALL,
@@ -91,7 +102,8 @@ REASONS = frozenset({
     REASON_CONTEXT_LOAD, REASON_NON_DICT, REASON_DUP_ELEMENT_NAMES,
     REASON_REPLACE_PATH_MISSING, REASON_PRECONDITION_ESCAPE,
     REASON_SITE_CONFLICT, REASON_PATCH_UNDECIDABLE,
-    REASON_ADMISSION_UNENCODABLE,
+    REASON_ADMISSION_UNENCODABLE, REASON_POISON_ROW,
+    REASON_BREAKER_OPEN, REASON_STAGE_RETRY_EXHAUSTED,
 })
 
 

@@ -16,6 +16,9 @@ Python equivalent of Go's net/http/pprof surface:
   N DecisionRecords + the error/shed ring (``?limit=N``)
 * ``/debug/coverage`` — the device-coverage ledger (per-rule placement,
   attributed host-fallback counts) as JSON
+* ``/debug/breakers`` — live circuit-breaker state per policy set
+  (state machine position, failure/trip counts, reopen countdowns) as
+  JSON
 * ``/metrics`` — Prometheus text exposition of the active registry
 """
 
@@ -157,6 +160,10 @@ class ProfilingServer:
                     body = dict(led.report(), enabled=True) \
                         if led is not None else {'enabled': False}
                     self._send(json.dumps(body), 'application/json')
+                elif parsed.path == '/debug/breakers':
+                    from ..serving import breaker as breaker_mod
+                    self._send(json.dumps(breaker_mod.debug_report()),
+                               'application/json')
                 elif parsed.path == '/metrics':
                     from . import device
                     from .metrics import global_registry

@@ -32,10 +32,30 @@ time is what makes the shared scanner safe by construction.  While a
 dispatch runs, new arrivals accumulate in the queue — that accumulation
 is where occupancy (and chip utilization) comes from.
 
-Failure semantics: a dispatch that raises sheds every rider to the host
-engine loop (reason ``scan_error``) and reports one failure to the
-owning handler's per-policy-set circuit breaker — identical to the sync
-path's recovery, amortized over the batch.
+Failure semantics: a dispatch that raises enters POISON QUARANTINE —
+the batcher bisects the batch (bounded depth) and re-dispatches the
+halves, so a single poison row no longer sheds N healthy riders: the
+healthy riders resolve on device from their sub-dispatches, each
+isolated poison row sheds to the host loop under reason ``poison_row``
+(``stage_retry_exhausted`` when the failure was a pipeline stage that
+exhausted its retry budget), and only a group still failing at the
+depth bound sheds wholesale under ``scan_error``.  A singleton failure
+gets one solo re-dispatch first, so transient device errors recover
+with no shed at all.
+
+The owning handler's per-policy-set circuit breaker hears at most ONE
+verdict per original dispatch, and the verdict distinguishes
+row-attributed evidence from infrastructure evidence: ``on_success``
+when quarantine resolved any rider on device (the backend is healthy —
+the failure was row-local); ``on_failure`` when nothing survived AND
+the failure looks systemic — a wholesale shed (depth-bound group or a
+retry-exhausted pipeline stage) or ``ALL_FAILED_BREAKER_AFTER``
+consecutive all-failed dispatches of the same key.  A dispatch whose
+only casualties were isolated poison rows (each failed twice solo —
+row-attributed by construction) is breaker-NEUTRAL: an unlucky
+all-poison batch must not quarantine the whole policy set to the host
+loop, while a genuinely broken backend still trips the breaker via the
+consecutive counter within a bounded number of dispatches.
 """
 
 from __future__ import annotations
@@ -47,6 +67,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
+from .. import faults
 from ..observability import coverage, tracing
 from ..observability.metrics import MetricsRegistry, global_registry
 from . import shed as shed_policy
@@ -65,6 +86,19 @@ OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 WAIT_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0)
 
+#: poison-quarantine bisection bound: KTPU_BATCH_MAX stays well under
+#: 2**8, so singleton isolation always completes within the bound,
+#: while a pathological failure storm stays O(depth * batch) dispatches
+QUARANTINE_MAX_DEPTH = 8
+
+#: consecutive all-failed dispatches of one key before poison-only
+#: evidence escalates to a breaker failure anyway: poison sheds are
+#: row-attributed (each row failed twice in isolation), so a single
+#: all-poison batch is breaker-neutral — but a backend that fails
+#: EVERY row of EVERY dispatch looks identical row-by-row, and this
+#: bound is how long the batcher entertains the row-local theory
+ALL_FAILED_BREAKER_AFTER = 3
+
 
 def _canon(v):
     """Order-canonical view of one admission-tuple element: dict keys
@@ -78,8 +112,10 @@ def _canon(v):
         try:
             return sorted(items, key=lambda x: json.dumps(
                 x, sort_keys=True, default=str))
-        except Exception:  # noqa: BLE001 - unsortable: keep order
-            return items
+        except Exception:  # ktpu: noqa[KTPU304] -- key
+            return items   # canonicalization, not a serving error:
+            # mixed-type lists that refuse a total order keep their
+            # arrival order (a worse coalescing key, never a failure)
     return v
 
 
@@ -142,6 +178,11 @@ class AdmissionBatcher:
         self._dispatches = 0
         self._hetero_dispatches = 0
         self._requests = 0
+        self._quarantine_dispatches = 0
+        # consecutive all-failed dispatch count per key; touched only
+        # by the batcher thread (dispatches are serialized), reset the
+        # moment any rider of the key resolves on device
+        self._all_failed: Dict = {}
         self._registered_on: Optional[MetricsRegistry] = None
         self._stopped = False
         self._thread = threading.Thread(
@@ -201,6 +242,51 @@ class AdmissionBatcher:
     def _dispatch(self, batch) -> None:
         t0 = time.monotonic()
         lead = batch[0]
+        self._observe(batch, t0)
+        from ..observability import provenance
+        try:
+            self._scan_and_resolve(batch, t0)
+        except Exception as e:  # noqa: BLE001 - riders quarantine, never a 500
+            resolved, _shed, wholesale = self._quarantine(
+                batch, t0, depth=1)
+            # the breaker hears at most one verdict per ORIGINAL
+            # dispatch: any rider resolving on device proves the
+            # backend healthy (the failure was row-local); nothing
+            # surviving is a breaker failure only on systemic evidence
+            # — a wholesale shed, or the key failing every row of
+            # ALL_FAILED_BREAKER_AFTER consecutive dispatches.  An
+            # all-poison batch (row-attributed sheds, first strike)
+            # stays neutral: no verdict, scanner keeps serving.
+            if resolved:
+                self._all_failed.pop(lead.key, None)
+                if self.on_success is not None:
+                    self.on_success(lead.policies)
+            else:
+                strikes = self._all_failed.get(lead.key, 0) + 1
+                self._all_failed[lead.key] = strikes
+                while len(self._all_failed) > 512:  # stray-key bound
+                    self._all_failed.pop(next(iter(self._all_failed)))
+                if (wholesale or strikes >= ALL_FAILED_BREAKER_AFTER) \
+                        and self.on_failure is not None:
+                    self.on_failure(lead.policies, e)
+            # flight-recorder dump last: the riders and the breaker are
+            # already notified, so the (file-writing) dump never delays
+            # recovery — the ring's history lands on disk next to the
+            # failure that triggered this quarantine
+            provenance.notify_scan_error(e)
+            return
+        self._all_failed.pop(lead.key, None)
+        if self.on_success is not None:
+            self.on_success(lead.policies)
+
+    def _scan_and_resolve(self, batch, t0: float) -> None:
+        """One shared device dispatch for ``batch``: scan, fill
+        provenance, resolve every rider.  Raises on failure — the
+        caller (``_dispatch`` / ``_quarantine``) owns shed and breaker
+        accounting.  Quarantine sub-dispatches re-enter here, so the
+        fault-injection row check re-fires per sub-batch and bisection
+        can isolate marker-poisoned rows."""
+        lead = batch[0]
         scanner = lead.scanner
         resources = [t.resource for t in batch]
         contexts = [t.context for t in batch]
@@ -213,7 +299,6 @@ class AdmissionBatcher:
         def pctx_factory(doc):
             return pctx_of.get(id(doc), lead_pctx)
 
-        self._observe(batch, t0)
         from ..observability import device as devtel
         from ..observability import provenance
         # per-dispatch provenance capture: device_eval time of THIS
@@ -231,28 +316,16 @@ class AdmissionBatcher:
         # key makes mixed tuples share this dispatch)
         if getattr(scanner, 'supports_row_admissions', False):
             extra['admissions'] = [t.admission for t in batch]
-        try:
-            with devtel.install_capture(cap), \
-                    tracing.tracer().start_span(
-                        'kyverno/serving/batch',
-                        {'occupancy': len(batch),
-                         'window_ms': self.window_s * 1000.0},
-                        parent=lead.span):
-                rows = scanner.scan(resources, contexts=contexts,
-                                    admission=lead.admission,
-                                    pctx_factory=pctx_factory, **extra)
-        except Exception as e:  # noqa: BLE001 - riders shed, never a 500
-            for t in batch:
-                t.shed(shed_policy.REASON_SCAN_ERROR)
-                self.sheds.record(shed_policy.REASON_SCAN_ERROR)
-            if self.on_failure is not None:
-                self.on_failure(lead.policies, e)
-            # flight-recorder dump last: the riders and the breaker are
-            # already notified, so the (file-writing) dump never delays
-            # recovery — the ring's history lands on disk next to the
-            # failure that shed this batch
-            provenance.notify_scan_error(e)
-            return
+        with devtel.install_capture(cap), \
+                tracing.tracer().start_span(
+                    'kyverno/serving/batch',
+                    {'occupancy': len(batch),
+                     'window_ms': self.window_s * 1000.0},
+                    parent=lead.span):
+            faults.check_rows(faults.SITE_BATCHER_DISPATCH, resources)
+            rows = scanner.scan(resources, contexts=contexts,
+                                admission=lead.admission,
+                                pctx_factory=pctx_factory, **extra)
         if cap is not None:
             device_eval_s = cap.stage_s('device_eval')
             share = device_eval_s / len(batch)
@@ -271,8 +344,60 @@ class AdmissionBatcher:
                 }
         for t, row in zip(batch, rows):
             t.resolve(row)
-        if self.on_success is not None:
-            self.on_success(lead.policies)
+
+    def _shed_batch(self, batch, reason: str) -> None:
+        for t in batch:
+            t.shed(reason)
+            self.sheds.record(reason)
+            if reason == shed_policy.REASON_POISON_ROW:
+                # the quarantined row is served by the host loop; the
+                # coverage ledger attributes that fall like any other
+                coverage.record_fallback(
+                    'serving', coverage.REASON_POISON_ROW)
+
+    def _quarantine(self, batch, t0: float, depth: int):
+        """Bisect a failed dispatch to isolate poison rows.
+
+        Returns ``(resolved, shed, wholesale)`` rider counts, where
+        ``wholesale`` is the subset of ``shed`` that is
+        infrastructure-shaped evidence: depth-bound groups (shed under
+        ``scan_error``, un-isolated) and retry-exhausted pipeline
+        failures (shed under ``stage_retry_exhausted``).  A singleton
+        gets one solo re-dispatch — transient device errors recover
+        with no shed at all — and only a persistently failing row
+        sheds, under ``poison_row``; those row-attributed sheds count
+        in ``shed`` but never in ``wholesale``, so the caller's breaker
+        verdict can tell an unlucky all-poison batch from a broken
+        backend, and the poison_row count stays an exact per-row
+        signal.
+        """
+        if depth > QUARANTINE_MAX_DEPTH:
+            self._shed_batch(batch, shed_policy.REASON_SCAN_ERROR)
+            return 0, len(batch), len(batch)
+        with self._stats_lock:
+            self._quarantine_dispatches += 1
+        if len(batch) == 1:
+            try:
+                self._scan_and_resolve(batch, t0)
+            except Exception as e:  # noqa: BLE001 - row is poison, shed it
+                exhausted = getattr(e, 'ktpu_retry_exhausted', False)
+                reason = shed_policy.REASON_STAGE_RETRY_EXHAUSTED \
+                    if exhausted else shed_policy.REASON_POISON_ROW
+                self._shed_batch(batch, reason)
+                return 0, 1, (1 if exhausted else 0)
+            return 1, 0, 0
+        mid = len(batch) // 2
+        resolved = shed = wholesale = 0
+        for half in (batch[:mid], batch[mid:]):
+            try:
+                self._scan_and_resolve(half, t0)
+                resolved += len(half)
+            except Exception:  # noqa: BLE001 - keep bisecting this half
+                r, s, w = self._quarantine(half, t0, depth + 1)
+                resolved += r
+                shed += s
+                wholesale += w
+        return resolved, shed, wholesale
 
     # -- telemetry ---------------------------------------------------------
 
@@ -331,8 +456,10 @@ class AdmissionBatcher:
             dispatches = self._dispatches
             hetero = self._hetero_dispatches
             requests = self._requests
+            quarantine = self._quarantine_dispatches
         return {
             'dispatches': dispatches,
+            'quarantine_dispatches': quarantine,
             'requests': requests,
             'occupancy_mean': (sum(occ) / len(occ)) if occ else 0.0,
             'occupancy_p50': self._p50(occ),
@@ -353,6 +480,7 @@ class AdmissionBatcher:
             self._dispatches = 0
             self._hetero_dispatches = 0
             self._requests = 0
+            self._quarantine_dispatches = 0
         self.sheds.reset()
 
     # -- lifecycle ---------------------------------------------------------

@@ -41,6 +41,7 @@ import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import faults
 from .keys import engine_rev, generation_key
 
 _log = logging.getLogger('kyverno.verdictcache')
@@ -262,9 +263,12 @@ class VerdictCache:
         if path is None or not os.path.exists(path):
             return
         try:
+            # an injected verdict_snapshot_read fault degrades exactly
+            # like an unreadable file: load as empty, rescan refills
+            faults.check(faults.SITE_VERDICT_SNAPSHOT)
             with open(path, 'rb') as f:
                 raw = f.read()
-        except OSError:
+        except Exception:  # noqa: BLE001 - unreadable snapshot: empty
             return
         header = len(_MAGIC) + _DIGEST_LEN
         payload = raw[header:]

@@ -222,17 +222,20 @@ class ResourceHandlers:
     generate / mutate-existing policies (reference: handlers.go:146-155).
     """
 
-    # consecutive device-scan failures before the device fast path is
-    # disabled for the handler's lifetime (each failure already pays a
-    # scanner rebuild; a persistently broken backend must not recompile
-    # the policy set on every request)
+    # consecutive device-scan failures before the set's circuit
+    # breaker opens and the host loop serves it for an exponential
+    # backoff window (each failure already pays a scanner rebuild; a
+    # persistently broken backend must not recompile the policy set on
+    # every request).  A half-open probe after the backoff decides
+    # between recovery and a re-trip (serving/breaker.py)
     DEVICE_FAILURE_LIMIT = 3
     # ceiling on simultaneous background scanner compiles (jax trace +
     # XLA compile are memory-heavy; a burst across many policy sets
     # serves the host loop rather than forking a compile per set)
     MAX_CONCURRENT_BUILDS = 2
-    # distinct policy sets whose breaker tripped before the failure is
-    # treated as systemic and the device path disables globally
+    # distinct policy sets whose breakers are simultaneously open
+    # before the failure is treated as systemic and the device path
+    # disables globally
     GLOBAL_DEAD_LIMIT = 3
 
     def __init__(self, cache: 'pcache.Cache', engine: Optional[Engine] = None,
@@ -310,21 +313,24 @@ class ResourceHandlers:
             collections.OrderedDict()
         self._scanners_max = 8
         self._building: set = set()
-        # per-policy-set consecutive failure counts (build or scan); a
-        # set that keeps failing goes to _dead_keys and serves the host
-        # loop permanently — per key, so one broken set cannot disable
-        # (nor have its counter reset by) a healthy one.  Both maps pin
-        # the policy objects (keys are id() tuples — a dead key must
-        # not outlive its policies, or CPython id reuse could silently
-        # circuit-break a healthy set) and are size-bounded.  When
-        # several distinct sets die the failure is systemic (broken
-        # backend): the global device switch turns off so policy churn
-        # cannot spawn an endless stream of doomed compiles.
-        self._key_failures: 'collections.OrderedDict[tuple, list]' = \
-            collections.OrderedDict()
-        self._dead_keys: 'collections.OrderedDict[tuple, Any]' = \
-            collections.OrderedDict()
-        self._breaker_cap = 64
+        # per-policy-set circuit breakers (serving/breaker.py): a set
+        # that keeps failing (build or scan) opens and serves the host
+        # loop for an exponential backoff window, then a single
+        # half-open probe decides between recovery — the set is
+        # re-admitted to the device path — and a re-trip with doubled
+        # backoff.  Per key, so one broken set cannot disable (nor
+        # reset the counter of) a healthy one; entries pin their
+        # policy objects (keys are id() tuples, so CPython id reuse
+        # must not circuit-break a healthy set) and the registry is
+        # size-bounded with counted evictions.  When several distinct
+        # sets are open at once the failure is systemic (broken
+        # backend): _breaker_opened turns the global device switch off
+        # so policy churn cannot spawn an endless stream of doomed
+        # compiles.
+        from ..serving.breaker import BreakerRegistry
+        self._breakers = BreakerRegistry(
+            failure_limit=self.DEVICE_FAILURE_LIMIT,
+            on_open=self._breaker_opened)
         # admission serving mode: 'batch' routes CREATE/UPDATE-path
         # validate AND mutate scans through the micro-batching scheduler
         # (serving/), 'sync' keeps the per-request dispatch
@@ -358,20 +364,36 @@ class ResourceHandlers:
         identical verdicts — until the compiled path is ready.  The
         circuit breaker is keyed per policy set (kindless): a backend
         broken for one program kind is broken for the other."""
+        from ..observability import coverage
+        from ..serving import breaker as breaker_mod
         base = self._policy_key(policies)
         key = (kind,) + base
+        decision = self._breakers.allow(base)
+        if decision == breaker_mod.OPEN:
+            # circuit open: host loop serves until the backoff elapses
+            # (or this window's single probe is already in flight)
+            coverage.record_fallback('serving',
+                                     coverage.REASON_BREAKER_OPEN)
+            return None
         with self._scanner_lock:
             scanner = self._scanners.get(key)
             if scanner is not None:
                 self._scanners.move_to_end(key)
+                # a PROBE grant rides this scanner: the caller's scan
+                # outcome reaches record_success/_record_key_failure
+                # downstream and resolves the half-open window
                 return scanner
-            if base in self._dead_keys:
-                return None  # circuit broken: host loop, no more builds
             if key in self._building:
+                if decision == breaker_mod.PROBE:
+                    # the probe cannot scan until the rebuild lands;
+                    # free the slot so the next window re-probes
+                    self._breakers.probe_abort(base)
                 return None  # still compiling; host loop serves meanwhile
             if len(self._building) >= self.MAX_CONCURRENT_BUILDS:
                 # a compile burst across many policy sets must not fork
                 # unbounded trace+compile threads; later requests retry
+                if decision == breaker_mod.PROBE:
+                    self._breakers.probe_abort(base)
                 return None
             self._building.add(key)
 
@@ -404,53 +426,59 @@ class ResourceHandlers:
                     self._building.discard(key)
         threading.Thread(target=build, name='ktpu-scanner-build',
                          daemon=True).start()
+        if decision == breaker_mod.PROBE:
+            # the probe's real verdict is the rebuild just spawned: a
+            # build failure re-trips via _record_key_failure; success
+            # caches the scanner for the next probe to ride.  Either
+            # way this caller serves the host loop now, so the slot
+            # frees for the next window
+            self._breakers.probe_abort(base)
         return None
 
     def _record_key_failure(self, key: tuple, policies, reason: str) -> None:
         import logging
         from ..observability.logging import with_values
+        from ..serving import breaker as breaker_mod
         log = logging.getLogger('kyverno.webhooks')
-        systemic = False
-        with self._scanner_lock:
-            entry = self._key_failures.get(key)
-            if entry is None:
-                entry = [0, list(policies)]  # pin ids while counted
-                while len(self._key_failures) >= self._breaker_cap:
-                    self._key_failures.popitem(last=False)
-                self._key_failures[key] = entry
-            entry[0] += 1
-            n = entry[0]
-            if n >= self.DEVICE_FAILURE_LIMIT:
-                while len(self._dead_keys) >= self._breaker_cap:
-                    self._dead_keys.popitem(last=False)
-                self._dead_keys[key] = entry[1]  # pin ids while dead
-                self._key_failures.pop(key, None)
-                if len(self._dead_keys) >= self.GLOBAL_DEAD_LIMIT:
-                    systemic = True
-                    self.device = False
+        state = self._breakers.record_failure(key, policies, reason)
         with_values(log, 'device path failure', level=logging.ERROR,
-                    error=reason, failures=n)
-        if n >= self.DEVICE_FAILURE_LIMIT:
-            with_values(log, 'device path disabled for this policy set '
-                        'after repeated failures', level=logging.ERROR)
-        if systemic:
-            with_values(log, 'device path disabled globally: multiple '
+                    error=reason, breaker_state=state)
+        if state == breaker_mod.OPEN:
+            with_values(log, 'circuit open: policy set quarantined to '
+                        'the host loop until the backoff elapses',
+                        level=logging.ERROR)
+
+    def _breaker_opened(self, open_count: int) -> None:
+        """BreakerRegistry trip callback: several distinct policy sets
+        open at once means the backend itself is broken — flip the
+        global device switch off so churn cannot spawn an endless
+        stream of doomed compiles (individual breakers still recover
+        per set if the operator re-enables the device path)."""
+        if open_count >= self.GLOBAL_DEAD_LIMIT and self.device:
+            import logging
+            from ..observability.logging import with_values
+            self.device = False
+            with_values(logging.getLogger('kyverno.webhooks'),
+                        'device path disabled globally: multiple '
                         'policy sets failing (systemic backend failure)',
                         level=logging.ERROR)
 
     def wait_device_ready(self, policies, timeout: float = 600.0) -> bool:
         """Block until the compiled scanner for ``policies`` is serving
         (benchmarks / tests measuring steady-state latency).  Returns
-        False immediately once the set's circuit breaker has tripped."""
+        False immediately while the set's circuit breaker is open."""
+        from ..serving import breaker as breaker_mod
         key = self._policy_key(policies)
         deadline = time.time() + timeout
         while time.time() < deadline:
             if not self.device:
                 return False
-            with self._scanner_lock:
-                if key in self._dead_keys:
-                    return False
+            if self._breakers.state(key) == breaker_mod.OPEN:
+                return False
             if self._device_scanner(policies) is not None:
+                # readiness polling never scans: release any half-open
+                # probe slot the allow() check granted on our behalf
+                self._breakers.probe_abort(key)
                 return True
             time.sleep(0.05)
         return False
@@ -471,10 +499,10 @@ class ResourceHandlers:
         return batcher
 
     def _batch_scan_ok(self, policies) -> None:
-        # mirror of the sync path's success bookkeeping: the breaker
-        # counts consecutive failures per set
-        with self._scanner_lock:
-            self._key_failures.pop(self._policy_key(policies), None)
+        # mirror of the sync path's success bookkeeping: a successful
+        # dispatch closes the set's breaker (half-open probe recovery)
+        # or forgets its consecutive-failure count
+        self._breakers.record_success(self._policy_key(policies))
 
     def _batch_scan_failed(self, policies, error) -> None:
         # mirror of the sync path's failure recovery: drop the broken
@@ -531,7 +559,18 @@ class ResourceHandlers:
         except Stopped:
             batcher.record_shed(shed_policy.REASON_SHUTDOWN)
             return None, {'path': f'shed:{shed_policy.REASON_SHUTDOWN}'}
-        responses = ticket.wait(batcher.shed_deadline_s)
+        deadline_s = batcher.shed_deadline_s
+        ts = request.get('timeoutSeconds')
+        if ts:
+            # the API server aborts the whole call at the webhook's own
+            # timeoutSeconds (reference: spec_types.go:95): shed at half
+            # that budget so the host-loop fallback still fits in the
+            # remainder, never loosening the KTPU_SHED_DEADLINE_MS cap
+            try:
+                deadline_s = min(deadline_s, max(0.01, float(ts) / 2.0))
+            except (TypeError, ValueError):
+                pass
+        responses = ticket.wait(deadline_s)
         if responses is None:
             reason = ticket.shed_reason or shed_policy.REASON_DEADLINE
             return None, {
@@ -594,9 +633,21 @@ class ResourceHandlers:
             if operation == 'UPDATE' else None
         if use_device:
             try:
+                from .. import faults
+                faults.check(faults.SITE_WEBHOOK_HANDLER)
                 scanner = self._device_scanner(policies)
                 if scanner is None:
-                    # compiled path still building: host loop this request
+                    # compiled path still building — or the set's
+                    # circuit breaker is open: host loop this request
+                    from ..serving import breaker as breaker_mod
+                    if self._breakers.state(self._policy_key(
+                            policies)) != breaker_mod.CLOSED:
+                        from ..serving import shed as shed_policy
+                        prov_path = \
+                            f'shed:{shed_policy.REASON_BREAKER_OPEN}'
+                        if self.serving_mode == 'batch':
+                            self._get_batcher().record_shed(
+                                shed_policy.REASON_BREAKER_OPEN)
                     use_device = False
                 elif self.serving_mode == 'batch':
                     # micro-batching scheduler: this request coalesces
@@ -640,10 +691,10 @@ class ResourceHandlers:
                             'fingerprint': getattr(scanner,
                                                    'fingerprint', ''),
                         }
-                    with self._scanner_lock:
-                        # the limit counts consecutive failures per set
-                        self._key_failures.pop(
-                            self._policy_key(policies), None)
+                    # success closes the set's breaker (recovery) or
+                    # forgets its consecutive-failure count
+                    self._breakers.record_success(
+                        self._policy_key(policies))
             except Exception as e:  # noqa: BLE001
                 # device failure must not turn into a 500: drop to the
                 # host engine loop and discard the broken scanner so the
@@ -858,9 +909,8 @@ class ResourceHandlers:
                            pctx.exclude_group_roles,
                            pctx.namespace_labels, operation),
                 pctx_factory=lambda doc: pctx)
-            with self._scanner_lock:
-                self._key_failures.pop(self._policy_key(mutate_policies),
-                                       None)
+            self._breakers.record_success(
+                self._policy_key(mutate_policies))
             return row
         except Exception as e:  # noqa: BLE001
             # identical never-500 recovery to the validate path: drop

@@ -366,6 +366,52 @@ def test_ktpu303_positive_negative(tmp_path):
     assert not rep.active
 
 
+def test_ktpu304_positive_negative(tmp_path):
+    # a serving-path handler that swallows Exception without shedding
+    # or re-raising hides a degradation from every ledger
+    rep = run(tmp_path, {'serving/a.py': """\
+    def f():
+        try:
+            g()
+        except Exception:
+            return None
+    """}, rules=['KTPU304'])
+    assert rule_ids(rep) == {'KTPU304'}
+    # recording a shed reason, re-raising, or narrowing the class —
+    # and any handler OUTSIDE serving/ or pipeline.py — are all fine
+    rep = run(tmp_path, {'serving/a.py': """\
+    def f(ledger):
+        try:
+            g()
+        except Exception:
+            ledger.record_shed('scan_error')
+        try:
+            g()
+        except Exception:
+            raise
+        try:
+            g()
+        except ValueError:
+            return None
+    """, 'elsewhere/a.py': """\
+    def f():
+        try:
+            g()
+        except Exception:
+            return None
+    """}, rules=['KTPU304'])
+    assert not rep.active
+    # pipeline.py is in scope wherever it lives
+    rep = run(tmp_path, {'compiler/pipeline.py': """\
+    def f():
+        try:
+            g()
+        except BaseException:
+            pass
+    """}, rules=['KTPU304'])
+    assert rule_ids(rep) == {'KTPU304'}
+
+
 # -- KTPU4xx: env-knob registry ----------------------------------------------
 
 def test_ktpu401_positive_negative(tmp_path):
@@ -652,7 +698,8 @@ def test_baseline_survives_line_drift(tmp_path):
 def test_rule_registry_complete():
     expected = {'KTPU001', 'KTPU002', 'KTPU101', 'KTPU102', 'KTPU103',
                 'KTPU201', 'KTPU202', 'KTPU203', 'KTPU204', 'KTPU205',
-                'KTPU301', 'KTPU302', 'KTPU303', 'KTPU401', 'KTPU402',
+                'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
+                'KTPU401', 'KTPU402',
                 'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():

@@ -387,7 +387,8 @@ def test_systemic_device_failure_disables_globally():
         key = handlers._policy_key(policies)
         for _ in range(handlers.DEVICE_FAILURE_LIMIT):
             handlers._record_key_failure(key, policies, 'injected')
-        assert key in handlers._dead_keys
+        from kyverno_tpu.serving import breaker
+        assert handlers._breakers.state(key) == breaker.OPEN
     assert handlers.device is False   # systemic: no more doomed compiles
     # admission still serves correct verdicts via the host loop
     server = WebhookServer(handlers)

@@ -1,0 +1,594 @@
+"""Fault injection, poison quarantine, breaker recovery (PR 13).
+
+Pins the degradation contract end to end: the ``KTPU_FAULTS`` harness
+is a bit-identical no-op when unarmed and fully deterministic when
+armed; the batcher's quarantine isolates exactly the poison rows while
+healthy riders resolve on device; the circuit breaker runs the
+closed → open → half-open → closed round trip under an injected clock;
+a crashed pipeline stage drains without leaking arena buffers; and the
+chaos load generator drives the whole serving chain through injected
+failures with zero non-200s.  CPU-only, tier-1.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+import yaml
+
+from kyverno_tpu import faults
+from kyverno_tpu.serving import shed as shed_policy
+from kyverno_tpu.serving.batcher import (ALL_FAILED_BREAKER_AFTER,
+                                         AdmissionBatcher)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No test leaves the process-wide injector armed."""
+    yield
+    faults.disable()
+
+
+def pod(labels, name):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'labels': labels},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+# ---------------------------------------------------------------------------
+# the injector: parsing, determinism, and the unarmed no-op
+
+
+class TestInjector:
+    def test_unarmed_is_a_noop(self):
+        faults.disable()
+        assert faults.active() is None
+        for site in faults.SITES:
+            faults.check(site)  # must not raise, count, or draw
+            faults.check_rows(site, [pod({'chaos': 'x'}, 'p')])
+
+    def test_spec_errors_fail_loudly(self):
+        for bad in ('site=nope,nth=1', 'site=encode', 'nth=1',
+                    'site=encode,nth=x', 'site=encode,nth=1,zap=1',
+                    'site=encode,p=1.5', 'site=encode,nth=1,error=Nope'):
+            with pytest.raises(faults.FaultSpecError):
+                faults.parse(bad)
+
+    def test_nth_fires_exactly_once(self):
+        inj = faults.configure('site=encode,nth=2,error=OSError')
+        inj.check(faults.SITE_ENCODE)
+        with pytest.raises(OSError) as ei:
+            inj.check(faults.SITE_ENCODE)
+        assert getattr(ei.value, 'ktpu_injected', False)
+        assert not getattr(ei.value, 'ktpu_retry_exhausted', False)
+        for _ in range(10):
+            inj.check(faults.SITE_ENCODE)  # never again
+        assert inj.counts() == {faults.SITE_ENCODE: 1}
+
+    def test_exhaust_marks_retry_exhausted(self):
+        inj = faults.configure('site=batcher_dispatch,nth=1,exhaust=1')
+        with pytest.raises(RuntimeError) as ei:
+            inj.check(faults.SITE_BATCHER_DISPATCH)
+        assert getattr(ei.value, 'ktpu_retry_exhausted', False)
+
+    def test_probability_draws_replay(self):
+        """The same (seed, spec) fires on the same call indices in
+        every run — chaos schedules replay deterministically."""
+        def fire_pattern():
+            inj = faults.Injector(faults.parse('site=h2d,p=0.3,seed=7'))
+            fired = []
+            for n in range(64):
+                try:
+                    inj.check(faults.SITE_H2D)
+                except RuntimeError:
+                    fired.append(n)
+            return fired
+        first = fire_pattern()
+        assert first and len(first) < 64
+        assert fire_pattern() == first
+
+    def test_marker_targets_rows(self):
+        inj = faults.configure('site=batcher_dispatch,marker=poison')
+        inj.check_rows(faults.SITE_BATCHER_DISPATCH,
+                       [pod({}, 'clean')])  # no marked row: no fire
+        with pytest.raises(RuntimeError):
+            inj.check_rows(faults.SITE_BATCHER_DISPATCH,
+                           [pod({}, 'a'), pod({'chaos': 'poison'}, 'b')])
+        assert inj.marked([pod({'chaos': 'poison'}, 'b'),
+                           pod({}, 'a')]) == 1
+
+    def test_fired_faults_count_on_metric(self):
+        from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                                       set_global_registry)
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            inj = faults.configure('site=d2h,nth=1')
+            with pytest.raises(RuntimeError):
+                inj.check(faults.SITE_D2H)
+            assert registry.counter_value(faults.FAULTS_INJECTED,
+                                          site=faults.SITE_D2H) == 1
+        finally:
+            set_global_registry(None)
+
+
+# ---------------------------------------------------------------------------
+# poison-batch quarantine: bisection, verdicts, and exact shed counts
+
+
+class _OracleScanner:
+    """Deterministic rows keyed by resource name; per-call log so the
+    tests can count sub-dispatches."""
+
+    def __init__(self):
+        self.calls = []
+
+    def scan(self, resources, contexts=None, admission=None,
+             pctx_factory=None):
+        self.calls.append([r['metadata']['name'] for r in resources])
+        return [[('row', r['metadata']['name'])] for r in resources]
+
+
+class _FailNScanner(_OracleScanner):
+    """Raise on the first ``n`` scan calls, then serve (a transient
+    device error)."""
+
+    def __init__(self, n=1, mark_exhausted=False):
+        super().__init__()
+        self.failures_left = n
+        self.mark_exhausted = mark_exhausted
+
+    def scan(self, resources, contexts=None, admission=None,
+             pctx_factory=None):
+        if self.failures_left:
+            self.failures_left -= 1
+            err = RuntimeError('transient device error')
+            if self.mark_exhausted:
+                err.ktpu_retry_exhausted = True
+            raise err
+        return super().scan(resources, contexts, admission, pctx_factory)
+
+
+class _AlwaysFailScanner(_OracleScanner):
+    def __init__(self, mark_exhausted=False):
+        super().__init__()
+        self.mark_exhausted = mark_exhausted
+        self.attempts = 0
+
+    def scan(self, resources, contexts=None, admission=None,
+             pctx_factory=None):
+        self.attempts += 1
+        err = RuntimeError('device gone')
+        if self.mark_exhausted:
+            err.ktpu_retry_exhausted = True
+        raise err
+
+
+def _submit(batcher, scanner, resource):
+    return batcher.submit(
+        resource=resource, context=None, pctx=None,
+        admission=({'userInfo': {'username': 'a'}}, [], {}, 'CREATE'),
+        scanner=scanner, policies=['pol'])
+
+
+def _callbacks():
+    calls = {'ok': 0, 'fail': 0}
+    return (calls,
+            lambda policies: calls.__setitem__('ok', calls['ok'] + 1),
+            lambda policies, e: calls.__setitem__('fail',
+                                                  calls['fail'] + 1))
+
+
+class TestQuarantine:
+    def test_poison_rows_isolated_riders_resolve(self):
+        """The pinned behavior: a marker-armed fault kills any dispatch
+        carrying the poison row; bisection isolates EXACTLY that row
+        (shed ``poison_row``), every healthy rider resolves on device
+        with the fault-free oracle's rows, and the breaker hears
+        success (the backend is healthy)."""
+        calls, ok, fail = _callbacks()
+        faults.configure('site=batcher_dispatch,marker=poison')
+        batcher = AdmissionBatcher(window_ms=60_000, max_batch=4,
+                                   queue_cap=16, on_success=ok,
+                                   on_failure=fail)
+        try:
+            scanner = _OracleScanner()
+            resources = [pod({}, 'a'), pod({'chaos': 'poison'}, 'bad'),
+                         pod({}, 'c'), pod({}, 'd')]
+            tickets = [_submit(batcher, scanner, r) for r in resources]
+            rows = [t.wait(shed_after_s=10.0) for t in tickets]
+            assert rows[0] == [('row', 'a')]
+            assert rows[2] == [('row', 'c')]
+            assert rows[3] == [('row', 'd')]
+            assert rows[1] is None
+            assert tickets[1].shed_reason == shed_policy.REASON_POISON_ROW
+            counts = batcher.sheds.counts()
+            assert counts.get(shed_policy.REASON_POISON_ROW) == 1
+            assert shed_policy.REASON_SCAN_ERROR not in counts
+            # the breaker verdict lands on the batcher thread right
+            # after the riders resolve — give it a beat
+            deadline = time.monotonic() + 10.0
+            while calls['ok'] < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert calls == {'ok': 1, 'fail': 0}
+        finally:
+            batcher.stop(drain=False)
+
+    def test_transient_singleton_recovers_without_shed(self):
+        """A singleton failure gets one solo re-dispatch: a transient
+        device error resolves the rider with NO shed at all."""
+        calls, ok, fail = _callbacks()
+        batcher = AdmissionBatcher(window_ms=5, queue_cap=16,
+                                   on_success=ok, on_failure=fail)
+        try:
+            scanner = _FailNScanner(n=1)
+            ticket = _submit(batcher, scanner, pod({}, 'a'))
+            assert ticket.wait(shed_after_s=10.0) == [('row', 'a')]
+            assert batcher.sheds.counts() == {}
+            deadline = time.monotonic() + 10.0
+            while calls['ok'] < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert calls == {'ok': 1, 'fail': 0}
+        finally:
+            batcher.stop(drain=False)
+
+    def test_all_poison_batch_is_breaker_neutral(self):
+        """A dispatch whose only casualties are row-attributed poison
+        sheds fires NEITHER breaker callback — an unlucky all-poison
+        batch must not quarantine the whole policy set — until
+        ALL_FAILED_BREAKER_AFTER consecutive all-failed dispatches
+        escalate it."""
+        calls, ok, fail = _callbacks()
+        batcher = AdmissionBatcher(window_ms=5, queue_cap=16,
+                                   on_success=ok, on_failure=fail)
+        try:
+            scanner = _AlwaysFailScanner()
+            for k in range(ALL_FAILED_BREAKER_AFTER):
+                ticket = _submit(batcher, scanner, pod({}, f'p{k}'))
+                assert ticket.wait(shed_after_s=10.0) is None
+                assert ticket.shed_reason == \
+                    shed_policy.REASON_POISON_ROW
+                # serialize dispatches: each submit must be its own
+                # dispatch for the consecutive-strike count to tick,
+                # and the verdict lands just after the solo retry
+                deadline = time.monotonic() + 10.0
+                want = 2 * (k + 1)  # original + solo retry per round
+                while scanner.attempts < want and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.005)
+                assert scanner.attempts == want
+                time.sleep(0.05)
+                if k + 1 < ALL_FAILED_BREAKER_AFTER:
+                    assert calls == {'ok': 0, 'fail': 0}, calls
+            deadline = time.monotonic() + 10.0
+            while calls['fail'] < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert calls == {'ok': 0, 'fail': 1}
+        finally:
+            batcher.stop(drain=False)
+
+    def test_retry_exhausted_is_wholesale_evidence(self):
+        """A retry-exhausted failure (the pipeline burned its whole
+        KTPU_STAGE_RETRIES budget) sheds ``stage_retry_exhausted`` and
+        counts as a breaker failure on the FIRST dispatch."""
+        calls, ok, fail = _callbacks()
+        batcher = AdmissionBatcher(window_ms=5, queue_cap=16,
+                                   on_success=ok, on_failure=fail)
+        try:
+            scanner = _AlwaysFailScanner(mark_exhausted=True)
+            ticket = _submit(batcher, scanner, pod({}, 'a'))
+            assert ticket.wait(shed_after_s=10.0) is None
+            assert ticket.shed_reason == \
+                shed_policy.REASON_STAGE_RETRY_EXHAUSTED
+            deadline = time.monotonic() + 10.0
+            while calls['fail'] < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert calls == {'ok': 0, 'fail': 1}
+        finally:
+            batcher.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# breaker lifecycle: the full round trip under an injected clock
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestBreaker:
+    def _registry(self, **kw):
+        from kyverno_tpu.serving.breaker import BreakerRegistry
+        clock = _Clock()
+        return clock, BreakerRegistry(clock=clock, base_s=1.0,
+                                      max_s=60.0, **kw)
+
+    def test_round_trip_closed_open_half_open_closed(self):
+        from kyverno_tpu.serving import breaker as breaker_mod
+        from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                                       set_global_registry)
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            opened = []
+            clock, breakers = self._registry(failure_limit=2,
+                                             on_open=opened.append)
+            key = ('k', 1)
+            assert breakers.allow(key) == breaker_mod.CLOSED
+            assert breakers.record_failure(key, ['pol'], 'e1') == \
+                breaker_mod.CLOSED
+            assert breakers.record_failure(key, ['pol'], 'e2') == \
+                breaker_mod.OPEN
+            assert opened == [1]
+            assert breakers.allow(key) == breaker_mod.OPEN
+            assert registry.gauge_value(breaker_mod.BREAKER_STATE,
+                                        state=breaker_mod.OPEN) == 1
+            report = breaker_mod.debug_report()
+            assert report['enabled']
+            row = next(r for r in report['breakers']
+                       if r['key'] == repr(key))
+            assert row['state'] == breaker_mod.OPEN
+            assert row['failures'] == 2 and row['trips'] == 1
+            assert row['reopens_in_s'] > 0
+            # backoff elapsed: exactly one caller gets the probe
+            clock.now += row['reopens_in_s'] + 0.01
+            assert breakers.allow(key) == breaker_mod.PROBE
+            assert breakers.allow(key) == breaker_mod.OPEN
+            assert breakers.state(key) == breaker_mod.HALF_OPEN
+            # probe success: entry gone, device path re-admitted
+            breakers.record_success(key)
+            assert breakers.state(key) == breaker_mod.CLOSED
+            assert breakers.allow(key) == breaker_mod.CLOSED
+            assert registry.gauge_value(breaker_mod.BREAKER_STATE,
+                                        state=breaker_mod.OPEN) == 0
+        finally:
+            set_global_registry(None)
+
+    def test_probe_failure_reopens_with_doubled_backoff(self):
+        from kyverno_tpu.serving import breaker as breaker_mod
+        clock, breakers = self._registry(failure_limit=1)
+        key = ('k', 2)
+        breakers.record_failure(key, ['pol'], 'boom')
+        first_backoff = next(
+            r for r in breakers.report()
+            if r['key'] == repr(key))['reopens_in_s']
+        clock.now += first_backoff + 0.01
+        assert breakers.allow(key) == breaker_mod.PROBE
+        assert breakers.record_failure(key, ['pol'], 'again') == \
+            breaker_mod.OPEN
+        second_backoff = next(
+            r for r in breakers.report()
+            if r['key'] == repr(key))['reopens_in_s']
+        assert second_backoff > first_backoff * 1.5
+
+    def test_probe_slot_aborts_and_self_heals(self):
+        from kyverno_tpu.serving import breaker as breaker_mod
+        clock, breakers = self._registry(failure_limit=1)
+        key = ('k', 3)
+        breakers.record_failure(key, ['pol'], 'boom')
+        clock.now += 100.0
+        assert breakers.allow(key) == breaker_mod.PROBE
+        # slot held: everyone else sheds...
+        assert breakers.allow(key) == breaker_mod.OPEN
+        # ...until the holder aborts (scanner still building)
+        breakers.probe_abort(key)
+        assert breakers.allow(key) == breaker_mod.PROBE
+        # a probe that never reports back must not wedge the breaker:
+        # a full backoff-sized window later the slot re-opens
+        clock.now += 100.0
+        assert breakers.allow(key) == breaker_mod.PROBE
+
+    def test_cap_evicts_closed_first_and_counts(self):
+        from kyverno_tpu.serving import breaker as breaker_mod
+        from kyverno_tpu.observability.metrics import (MetricsRegistry,
+                                                       set_global_registry)
+        registry = MetricsRegistry()
+        set_global_registry(registry)
+        try:
+            _clock, breakers = self._registry(failure_limit=3, cap=2)
+            breakers.record_failure(('closed', 1), ['pol'], 'e')
+            breakers.record_failure(('open', 1), ['pol'], 'e')
+            breakers.record_failure(('open', 1), ['pol'], 'e')
+            breakers.record_failure(('open', 1), ['pol'], 'e')
+            assert breakers.state(('open', 1)) == breaker_mod.OPEN
+            # at cap: the CLOSED entry is the victim, not the open one
+            breakers.record_failure(('new', 1), ['pol'], 'e')
+            assert breakers.state(('closed', 1)) == breaker_mod.CLOSED
+            assert breakers.state(('open', 1)) == breaker_mod.OPEN
+            assert registry.counter_value(
+                breaker_mod.BREAKER_EVICTIONS) == 1
+        finally:
+            set_global_registry(None)
+
+
+# ---------------------------------------------------------------------------
+# pipeline resilience: stage retries and the no-leak drain
+
+
+class _Arena:
+    """Toy buffer owner: values check out of ``live`` on cleanup or
+    on reaching the consumer — anything left is a leak."""
+
+    def __init__(self):
+        self.live = set()
+
+    def alloc(self, v):
+        self.live.add(v)
+        return v
+
+    def release(self, v):
+        self.live.discard(v)
+
+
+class TestPipelineResilience:
+    def test_transient_stage_error_retries_transparently(self):
+        from kyverno_tpu.compiler.pipeline import ChunkPipeline
+        attempts = {'n': 0}
+
+        def flaky(v):
+            attempts['n'] += 1
+            if attempts['n'] == 1:
+                raise RuntimeError('hiccup')
+            return v * 10
+
+        pipe = ChunkPipeline([('stage', flaky)], depth=2, retries=1)
+        assert list(pipe.run([1, 2, 3])) == [10, 20, 30]
+        assert attempts['n'] == 4  # one retry, zero surfaced errors
+
+    def test_exhausted_retries_mark_and_release(self):
+        from kyverno_tpu.compiler.pipeline import ChunkPipeline
+        arena = _Arena()
+
+        def always_fails(v):
+            raise RuntimeError('stage dead')
+
+        pipe = ChunkPipeline(
+            [('alloc', arena.alloc), ('boom', always_fails)],
+            depth=2, retries=2, cleanup=arena.release)
+        with pytest.raises(RuntimeError) as ei:
+            list(pipe.run([1]))
+        assert getattr(ei.value, 'ktpu_retry_exhausted', False)
+        assert getattr(ei.value, 'ktpu_stage', '') == 'boom'
+        assert arena.live == set()
+
+    def test_stage_crash_drain_releases_all_buffers(self):
+        """The pinned behavior: a mid-stream stage crash ends the run
+        with every in-flight chunk's buffers reclaimed — an aborted
+        scan leaks nothing."""
+        from kyverno_tpu.compiler.pipeline import ChunkPipeline
+        arena = _Arena()
+
+        def crash_on_two(v):
+            if v == 2:
+                raise RuntimeError('chunk 2 kills the stage')
+            return v
+
+        pipe = ChunkPipeline(
+            [('alloc', arena.alloc), ('eval', crash_on_two)],
+            depth=2, retries=0, cleanup=arena.release)
+        got = []
+        with pytest.raises(RuntimeError):
+            for v in pipe.run(range(8)):
+                got.append(v)
+                arena.release(v)  # the consumer owns yielded chunks
+        assert got == [0, 1]
+        assert arena.live == set(), f'leaked buffers: {arena.live}'
+
+
+# ---------------------------------------------------------------------------
+# loadgen chaos schedule + the serving chain end to end
+
+ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+
+class TestChaosLoadgen:
+    def test_poison_marking_is_deterministic_and_isolated(self):
+        """poison_ratio=0 (the default) draws the exact same traffic as
+        an unmarked cluster, and a poisoned cluster only changes the
+        marked rows — the fault-free oracle stays valid."""
+        from kyverno_tpu.conformance.loadgen import SyntheticCluster
+        base = SyntheticCluster(seed=3)
+        off = SyntheticCluster(seed=3, poison_ratio=0.0)
+        on = SyntheticCluster(seed=3, poison_ratio=0.25)
+        marked = 0
+        for i in range(32):
+            assert base.request(i) == off.request(i)
+            req = on.request(i)
+            if on.is_poison(i):
+                marked += 1
+                labels = req['object']['metadata']['labels']
+                assert labels.get('chaos') == 'poison'
+                assert req['operation'] == 'CREATE'
+                assert not on.is_exception_tenant(
+                    req['userInfo']['username'])
+        assert marked == on.poison_count(32) == 8
+
+    def test_chaos_wave_end_to_end_zero_non_200(self):
+        """The pinned behavior: concurrent synthetic-cluster traffic
+        with the poison fault schedule armed answers every request 200
+        with the fault-free oracle's verdict, and sheds ``poison_row``
+        exactly once per injected poison row."""
+        from kyverno_tpu.api.policy import Policy
+        from kyverno_tpu.conformance.loadgen import SyntheticCluster
+        from kyverno_tpu.policycache import cache as pcache
+        from kyverno_tpu.policycache.cache import Cache
+        from kyverno_tpu.webhooks.handlers import ResourceHandlers
+        from kyverno_tpu.webhooks.server import WebhookServer
+
+        cache = Cache()
+        cache.warm_up([Policy(d)
+                       for d in yaml.safe_load_all(ENFORCE_POLICY)])
+        from kyverno_tpu.config.config import Configuration
+        handlers = ResourceHandlers(cache, configuration=Configuration(),
+                                    serving_mode='batch')
+        server = WebhookServer(handlers, configuration=Configuration())
+        try:
+            cluster = SyntheticCluster(seed=11, poison_ratio=1 / 6)
+            enforce = cache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod',
+                                         cluster.namespaces[0])
+            if not handlers.wait_device_ready(enforce, timeout=600):
+                pytest.skip('device scanner never became ready')
+            threads, per_thread = 4, 6
+            total = threads * per_thread
+
+            def send(i):
+                body, status = server.handle_request(
+                    '/validate/fail', cluster.review_bytes(i))
+                return status, json.loads(body).get('response')
+
+            faults.disable()
+            oracle = {}
+            for i in range(total):
+                status, resp = oracle[i] = send(i)
+                assert status == 200
+            faults.configure(cluster.fault_spec())
+            before = dict(handlers._get_batcher().stats()['shed'])
+            got = [None] * total
+            barrier = threading.Barrier(threads)
+
+            def work(tid):
+                barrier.wait()
+                for j in range(per_thread):
+                    k = tid + j * threads
+                    got[k] = send(k)
+
+            workers = [threading.Thread(target=work, args=(tid,))
+                       for tid in range(threads)]
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(120)
+            faults.disable()
+            assert all(s == 200 for s, _r in got)
+            assert [r for _s, r in got] == \
+                [oracle[i][1] for i in range(total)]
+            after = dict(handlers._get_batcher().stats()['shed'])
+            shed_poison = after.get('poison_row', 0) - \
+                before.get('poison_row', 0)
+            assert shed_poison == cluster.poison_count(total) == 4
+        finally:
+            faults.disable()
+            handlers.shutdown()
