@@ -21,9 +21,10 @@ Layout:
 * :mod:`.taxonomy` — KTPU301/302/303 (fallback-reason taxonomy drift)
 * :mod:`.envreg` — KTPU401/402 (``KTPU_*`` knob registry drift)
 * :mod:`.catalog_pass` — KTPU501/502/503 (metric catalog drift; the
-  framework home of ``scripts/check_metric_names.py``) and
+  framework home of ``scripts/check_metric_names.py``),
   KTPU504/505 (span-name catalog drift against
-  ``observability/catalog.py:SPANS``)
+  ``observability/catalog.py:SPANS``), and KTPU506 (unit mismatch:
+  ``*_seconds``/``*_bytes`` metrics fed ms or str-length values)
 * :mod:`.knobs` — the machine-readable ``KTPU_*`` knob registry that
   drives both KTPU401/402 and the README knob table
 """

@@ -28,6 +28,14 @@ module API, and exit codes are unchanged).
   starts — the span analogue of KTPU503, so the README span table
   (generated from the same catalog) can never document spans that no
   longer exist.
+* **KTPU506** — unit mismatch at a write site: a cataloged metric whose
+  name declares its unit (``*_seconds[_total]`` / ``*_bytes[_total]``)
+  is fed a value that carries the wrong one — a ``*_ms`` name with no
+  ``/ 1000`` conversion in the expression (milliseconds exported as
+  seconds are off by 1000x on every dashboard), or ``len()`` of a str
+  for a bytes metric (characters, not bytes — encode first).  Values
+  are resolved one level through local assignments, the same
+  local-dataflow depth as KTPU204.
 """
 
 from __future__ import annotations
@@ -319,6 +327,180 @@ def _check_dead_spans(ctx: Context) -> Iterable[Finding]:
             'KTPU505', line,
             f'span catalog: {name!r} has no start site in the tree — '
             f'remove the entry or add the span')
+
+
+# -- unit-mismatch pass (KTPU506) ---------------------------------------------
+
+#: registry writes that carry a measured value (register_histogram
+#: takes buckets, clear_gauge takes nothing — neither can mismatch)
+_VALUE_METHODS = {'inc', 'observe', 'set_gauge'}
+
+
+def _metric_unit(name: str) -> Optional[str]:
+    """'seconds' | 'bytes' when the metric name declares a unit."""
+    base = name[:-len('_total')] if name.endswith('_total') else name
+    if base.endswith('_seconds'):
+        return 'seconds'
+    if base.endswith('_bytes'):
+        return 'bytes'
+    return None
+
+
+def _iter_scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_nodes(scope: ast.AST):
+    """Every node in ``scope`` excluding nested function bodies (each
+    nested function is visited as its own scope)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _value_arg(call: ast.Call) -> Optional[ast.AST]:
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg in ('value', 'amount', 'seconds'):
+            return kw.value
+    return None  # inc() with the implicit 1.0 — no unit to carry
+
+
+def _ms_name(expr: ast.AST) -> Optional[str]:
+    """A terminal ``*_ms`` name/attribute inside ``expr``, if any."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id.endswith('_ms'):
+            return node.id
+        if isinstance(node, ast.Attribute) and node.attr.endswith('_ms'):
+            return node.attr
+    return None
+
+
+def _has_ms_conversion(expr: ast.AST) -> bool:
+    """True when ``expr`` contains a ms→s conversion (``/ 1000`` or
+    ``* 0.001`` against a constant)."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.BinOp):
+            continue
+        if isinstance(node.op, ast.Div) and \
+                isinstance(node.right, ast.Constant) and \
+                node.right.value in (1000, 1000.0):
+            return True
+        if isinstance(node.op, ast.Mult):
+            for side in (node.left, node.right):
+                if isinstance(side, ast.Constant) and \
+                        side.value == 0.001:
+                    return True
+    return False
+
+
+def _is_str_expr(expr: ast.AST) -> bool:
+    """Conservatively: does ``expr`` evaluate to a str?"""
+    if isinstance(expr, ast.Constant):
+        return isinstance(expr.value, str)
+    if isinstance(expr, ast.JoinedStr):
+        return True
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in ('str', 'repr'):
+            return True
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ('decode', 'dumps', 'format', 'join'):
+            return True
+    return False
+
+
+def _str_len_call(expr: ast.AST, bindings: Dict[str, ast.AST]
+                  ) -> bool:
+    """``len(<str-valued expression>)`` anywhere in ``expr``, with the
+    len argument resolved one level through local assignments."""
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Name) and
+                node.func.id == 'len' and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            arg = bindings.get(arg.id, arg)
+        if _is_str_expr(arg):
+            return True
+    return False
+
+
+@register('KTPU506', 'unit mismatch: a *_seconds/*_bytes metric '
+                     'written from a *_ms value (no /1000) or a '
+                     'len() of a str')
+def _check_unit_mismatch(ctx: Context) -> Iterable[Finding]:
+    from .retrace import _scope_bindings
+    all_consts: Dict[str, str] = {}
+    for sf in ctx.files:
+        if sf.tree is not None:
+            all_consts.update(_module_constants(sf.tree))
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        local_consts = _module_constants(sf.tree)
+        for scope in _iter_scopes(sf.tree):
+            bindings = _scope_bindings(scope)
+            for node in _scope_nodes(scope):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _VALUE_METHODS and node.args):
+                    continue
+                arg = node.args[0]
+                name: Optional[str] = None
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    name = arg.value
+                elif isinstance(arg, ast.Name):
+                    name = local_consts.get(arg.id,
+                                            all_consts.get(arg.id))
+                elif isinstance(arg, ast.Attribute):
+                    name = all_consts.get(arg.attr)
+                unit = _metric_unit(name) if name is not None else None
+                if unit is None:
+                    continue
+                value = _value_arg(node)
+                if value is None:
+                    continue
+                # one-level local-dataflow resolution (KTPU204 depth):
+                # a bare name checks its own spelling AND what it was
+                # assigned from in this scope
+                exprs = [value]
+                if isinstance(value, ast.Name):
+                    resolved = bindings.get(value.id)
+                    if resolved is not None:
+                        exprs.append(resolved)
+                if unit == 'seconds':
+                    for expr in exprs:
+                        ms = _ms_name(expr)
+                        if ms is not None and \
+                                not any(_has_ms_conversion(e)
+                                        for e in exprs):
+                            yield sf.finding(
+                                'KTPU506', node.lineno,
+                                f'{name} is a seconds metric but its '
+                                f'value derives from {ms!r} with no '
+                                f'/1000 conversion — milliseconds '
+                                f'exported as seconds are off by '
+                                f'1000x on every consumer')
+                            break
+                elif unit == 'bytes':
+                    if any(_str_len_call(e, bindings) for e in exprs):
+                        yield sf.finding(
+                            'KTPU506', node.lineno,
+                            f'{name} is a bytes metric but its value '
+                            f'is len() of a str — that counts '
+                            f'characters, not bytes; len(s.encode()) '
+                            f'measures the wire size')
 
 
 def render_span_table() -> str:

@@ -60,7 +60,9 @@ class Setup:
             from ..observability.metrics import set_global_registry
             from ..observability import coverage
             from ..observability import device as device_telemetry
+            from ..observability import executables
             from ..observability import provenance
+            from ..observability import slo
             set_global_registry(self.metrics)
             device_telemetry.configure(self.metrics)
             # device-coverage ledger: per-rule placement + attributed
@@ -70,6 +72,12 @@ class Setup:
             # the flight recorder (GET /debug/decisions with --profile;
             # KTPU_FLIGHT_N=0 keeps it off)
             provenance.configure(self.metrics)
+            # executable lifecycle ledger (GET /debug/executables;
+            # KTPU_EXEC_LEDGER_N=0 keeps it off)
+            executables.configure(self.metrics)
+            # admission-latency SLO engine (GET /debug/slo; off unless
+            # KTPU_SLO_WINDOW_S > 0)
+            slo.configure(self.metrics)
         self.configuration = Configuration()
         if client is None:
             from ..dclient.client import FakeClient
@@ -121,6 +129,10 @@ class Setup:
                 hook()
             except Exception:  # noqa: BLE001
                 self.logger.exception('shutdown hook failed')
+        # residency gauges (queue depth, in-flight chunks, breaker
+        # states) describe live occupancy: once everything above has
+        # drained, a scrape must see 0, not the last sampled value
+        self.metrics.reset_residency_gauges()
 
     def install_signal_handlers(self) -> None:
         def handler(signum, frame):

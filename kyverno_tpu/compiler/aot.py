@@ -141,10 +141,12 @@ def _reject(store: AotStore, key: str, reason: str) -> None:
     back to a fresh compile (persistent-XLA-cache assisted), which is
     always safe — a forced load of a feature-mismatched executable can
     SIGILL the process."""
+    from ..observability import executables
     _log.warning('aot entry %s rejected at load (%s); dropping',
                  key[:12], reason)
     store.delete(key)
     _count_rejection(reason)
+    executables.record_eviction(key, reason)
 
 
 def load_executable(key: str, store: Optional[AotStore] = None) -> Any:
@@ -225,7 +227,9 @@ def evict_executable(key: str, store: Optional[AotStore] = None,
     the eviction on ``aot_load_rejected_total``."""
     (store or default_store()).delete(key)
     if reason is not None:
+        from ..observability import executables
         _count_rejection(reason)
+        executables.record_eviction(key, reason)
 
 
 def warm_cache_dir() -> Optional[str]:

@@ -160,6 +160,24 @@ METRICS: Dict[str, Metric] = {
         'counter', 'Span-exporter failures by exporter class; an '
         'exporter failing repeatedly is dropped after this counts it, '
         'so a dead exporter is visible instead of silent.'),
+    # executable ledger (observability/executables.py)
+    'kyverno_tpu_executable_count': Metric(
+        'gauge', 'Live compiled executables in the lifecycle ledger, '
+        'by source=fresh_compile|aot_load|persistent_xla.'),
+    'kyverno_tpu_executable_dispatches_total': Metric(
+        'counter', 'Device dispatches served per executable '
+        'acquisition source.'),
+    'kyverno_tpu_executable_device_seconds_total': Metric(
+        'counter', 'Cumulative device-eval seconds spent per '
+        'executable acquisition source.'),
+    # serving SLO engine (observability/slo.py)
+    'kyverno_tpu_slo_burn_rate': Metric(
+        'gauge', 'Admission-latency error-budget burn rate '
+        '(error_rate / (1 - KTPU_SLO_TARGET)) by window=short|long; '
+        '1.0 spends the budget exactly at the sustainable rate.'),
+    'kyverno_tpu_slo_budget_remaining': Metric(
+        'gauge', 'Fraction of the long-window error budget left '
+        '(1 - long-window burn rate); negative means overspent.'),
 }
 
 
@@ -196,4 +214,8 @@ SPANS: Dict[str, str] = {
                       'filter + dense scan of the misses).',
     'kyverno/background/ur': 'One UpdateRequest sync.',
     'kyverno/aot/warmer': 'Background AOT warm-up pass.',
+    'kyverno/executable/<event>': 'Executable-ledger lifecycle event '
+                                  '(build/evict) as a zero-duration '
+                                  'span; the JSONL trace exporter is '
+                                  'the lifecycle log.',
 }

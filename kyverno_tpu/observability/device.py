@@ -86,6 +86,9 @@ def configure(registry: Optional[MetricsRegistry] = None,
     global _registry, _watchdog, _event_sink
     reg = registry or global_registry() or MetricsRegistry()
     reg.register_histogram(SCAN_STAGE_DURATION, WIDE_BUCKETS)
+    # in-flight chunks is a residency gauge: once the pipeline drains
+    # it must export 0 (swept by cmd/internal.Setup.shutdown)
+    reg.mark_reset_on_close(PIPELINE_INFLIGHT)
     _event_sink = event_sink
     threshold = stall_threshold_s if stall_threshold_s is not None \
         else _stall_threshold_default()

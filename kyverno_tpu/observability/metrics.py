@@ -33,6 +33,28 @@ class MetricsRegistry:
         self._hists: Dict[str, Dict[Tuple, List]] = {}
         self._buckets: Dict[str, Tuple[float, ...]] = {}
         self._disabled = set(disabled or [])
+        self._reset_on_close: set = set()
+
+    def mark_reset_on_close(self, name: str) -> None:
+        """Mark ``name`` as a *residency* gauge: it describes live
+        occupancy (queue depth, in-flight chunks, breaker states), so
+        after a drain/shutdown its series must export 0, not whatever
+        the last sample happened to be.  Swept by
+        :meth:`reset_residency_gauges` (cmd/internal.Setup.shutdown)."""
+        with self._lock:
+            self._reset_on_close.add(name)
+
+    def reset_residency_gauges(self) -> None:
+        """Zero every series of every gauge marked reset_on_close.
+        Series are zeroed, not retracted — 'scraped the drained server
+        and saw 0' is the signal; a vanished series reads as target
+        loss."""
+        with self._lock:
+            for name in self._reset_on_close:
+                series = self._gauges.get(name)
+                if series is not None:
+                    for key in series:
+                        series[key] = 0.0
 
     def register_histogram(self, name: str,
                            buckets: Tuple[float, ...]) -> None:

@@ -1819,6 +1819,10 @@ def build_evaluator(cps: CompiledPolicySet):
     jitted = jax.jit(evaluate_packed)
     fingerprint = policy_set_fingerprint(cps.policies)
     exec_cache: Dict[str, Any] = {}
+    # id(compiled) -> ledger key: dispatch-site attribution for the
+    # executable lifecycle ledger without re-deriving the cache key per
+    # call (entries live exactly as long as exec_cache holds them)
+    exec_keys: Dict[int, str] = {}
     # input signatures the jitted fallback has already traced — mirrors
     # jax.jit's own cache key well enough for hit/miss telemetry on the
     # paths where the AOT executable cache is unavailable (mesh, >1
@@ -1834,8 +1838,10 @@ def build_evaluator(cps: CompiledPolicySet):
         """Executable for this input signature: memory → AOT disk →
         trace+compile (and populate both).  None → mesh-sharded inputs
         or AOT disabled; caller falls back to the jitted path."""
+        import time as _time
         from ..compiler import aot
         from ..observability import device as devtel
+        from ..observability import executables as exectel
         key = aot.executable_cache_key(fingerprint, packed,
                                        extra=(str(fdet_k),))
         if key is None:
@@ -1845,6 +1851,12 @@ def build_evaluator(cps: CompiledPolicySet):
             if hit is not None:
                 devtel.record_cache('hit')
                 return hit
+        # the packed buffers all lead with the resource axis, so any
+        # buffer's first dim is the canonical row capacity (ledger
+        # attribute; pack_batch coalesces per dtype, capacity-invariant)
+        capacity = next((int(v.shape[0]) for v in packed.values()
+                         if getattr(v, 'ndim', 0) >= 1), 0) \
+            if exectel.enabled() else 0
         # the disk deserialize runs OUTSIDE the compile lock: it never
         # touches layout_holder, and the shape warmer loads the
         # canonical capacities on a thread pool — serializing the
@@ -1852,21 +1864,37 @@ def build_evaluator(cps: CompiledPolicySet):
         # instead of a max.  Two racers on ONE key at worst both
         # deserialize; setdefault keeps a single winner.
         with devtel.stage('compile') as st:
+            t0 = _time.monotonic()
             loaded = aot.load_executable(key)
             if loaded is not None:
                 devtel.record_cache('aot_load')
                 st.set_attribute('cache', 'aot_load')
                 with compile_lock:
-                    return exec_cache.setdefault(key, loaded)
+                    winner = exec_cache.setdefault(key, loaded)
+                    if winner is loaded and exectel.enabled():
+                        exec_keys[id(winner)] = key
+                        exectel.record_build(
+                            key, fingerprint=fingerprint,
+                            capacity=capacity, source='aot_load',
+                            build_s=_time.monotonic() - t0,
+                            compiled=winner)
+                    return winner
             with compile_lock:
                 hit = exec_cache.get(key)
                 if hit is not None:
                     devtel.record_cache('hit')
                     return hit
                 layout_holder['layout'] = layout
+                t0 = _time.monotonic()
                 loaded = jitted.lower(packed).compile()
                 devtel.record_cache('miss')
                 st.set_attribute('cache', 'miss')
+                if exectel.enabled():
+                    exec_keys[id(loaded)] = key
+                    exectel.record_build(
+                        key, fingerprint=fingerprint, capacity=capacity,
+                        source='fresh_compile',
+                        build_s=_time.monotonic() - t0, compiled=loaded)
                 aot.store_executable_async(key, loaded)
                 devtel.record_cache('aot_store')
                 exec_cache[key] = loaded
@@ -1881,7 +1909,9 @@ def build_evaluator(cps: CompiledPolicySet):
         if key is None:
             return
         with compile_lock:
-            exec_cache.pop(key, None)
+            dropped = exec_cache.pop(key, None)
+            if dropped is not None:
+                exec_keys.pop(id(dropped), None)
         aot.evict_executable(key, reason='execute_failed')
 
     def call(packed: Dict[str, Any],
@@ -1889,7 +1919,9 @@ def build_evaluator(cps: CompiledPolicySet):
         # i64 lanes are required: quantity milli-values span past 2^31.
         # Scope x64 to this call instead of flipping the process-global
         # flag at import time.
+        import time as _time
         from ..observability import device as devtel
+        from ..observability import executables as exectel
         with enable_x64():
             try:
                 compiled = _compiled_for(packed, layout)
@@ -1899,6 +1931,13 @@ def build_evaluator(cps: CompiledPolicySet):
                 try:
                     with devtel.stage('device_eval') as st:
                         _stamp_coverage(st)
+                        if exectel.enabled():
+                            t0 = _time.monotonic()
+                            out = compiled(packed)
+                            exectel.record_dispatch(
+                                exec_keys.get(id(compiled), ''),
+                                _time.monotonic() - t0)
+                            return out
                         return compiled(packed)
                 except Exception:  # noqa: BLE001 - a deserialized
                     # executable can fail at EXECUTE time (e.g. machine-
@@ -1908,10 +1947,18 @@ def build_evaluator(cps: CompiledPolicySet):
                     _evict_aot(packed)
             with compile_lock:
                 layout_holder['layout'] = layout
-                if devtel.enabled():
+                exec_on = exectel.enabled()
+                pkey = ''
+                if devtel.enabled() or exec_on:
                     sig = tuple(
                         (k, str(v.dtype), tuple(v.shape))
                         for k, v in sorted(packed.items()))
+                    if exec_on:
+                        # no AOT cache key on this path (mesh / AOT
+                        # off): a process-local pseudo-key names the
+                        # jit-backed executable in the ledger
+                        pkey = f'jit:{fingerprint[:12]}:' \
+                               f'{abs(hash(sig)):x}'
                     if sig not in jit_seen:
                         # first call at this signature pays jit trace +
                         # XLA compile inside the dispatch — time it as
@@ -1921,10 +1968,28 @@ def build_evaluator(cps: CompiledPolicySet):
                         devtel.record_cache('miss')
                         with devtel.stage('compile') as st:
                             st.set_attribute('cache', 'miss')
-                            return jitted(packed)
+                            t0 = _time.monotonic()
+                            out = jitted(packed)
+                            if exec_on:
+                                exectel.record_build(
+                                    pkey, fingerprint=fingerprint,
+                                    capacity=next(
+                                        (int(v.shape[0])
+                                         for v in packed.values()
+                                         if getattr(v, 'ndim', 0) >= 1),
+                                        0),
+                                    source='persistent_xla',
+                                    build_s=_time.monotonic() - t0)
+                            return out
                     devtel.record_cache('hit')
                 with devtel.stage('device_eval') as st:
                     _stamp_coverage(st)
+                    if pkey:
+                        t0 = _time.monotonic()
+                        out = jitted(packed)
+                        exectel.record_dispatch(
+                            pkey, _time.monotonic() - t0)
+                        return out
                     return jitted(packed)
 
     call.jitted = jitted

@@ -411,6 +411,9 @@ class AdmissionBatcher:
             registry.register_histogram(HETERO_OCCUPANCY,
                                         OCCUPANCY_BUCKETS)
             registry.register_histogram(QUEUE_WAIT, WAIT_BUCKETS)
+            # queue depth is a residency gauge: a drained server must
+            # export 0 (swept by cmd/internal.Setup.shutdown)
+            registry.mark_reset_on_close(QUEUE_DEPTH)
             self._registered_on = registry
         return registry
 

@@ -174,6 +174,9 @@ class BreakerRegistry:
         registry = global_registry()
         if registry is None:
             return
+        # breaker occupancy is a residency gauge: after shutdown the
+        # sweep (cmd/internal.Setup.shutdown) zeroes every state series
+        registry.mark_reset_on_close(BREAKER_STATE)
         counts = {s: 0 for s in STATES}
         for e in self._entries.values():
             counts[e.state] += 1

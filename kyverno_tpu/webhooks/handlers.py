@@ -599,8 +599,10 @@ class ResourceHandlers:
         policies = self.cache.get_policies(pcache.VALIDATE_ENFORCE, kind, ns)
         generate_policies = self.cache.get_policies(pcache.GENERATE, kind, ns)
         from ..observability import provenance
+        from ..observability import slo
         prov_on = provenance.enabled()
-        t_start = time.monotonic() if prov_on else 0.0
+        slo_on = slo.enabled()
+        t_start = time.monotonic() if (prov_on or slo_on) else 0.0
         # decision provenance: which serving path answered this request
         # (batch | sync | shed:<reason> | host_fallback) plus the
         # batch/cache attribution that path produced
@@ -609,13 +611,17 @@ class ResourceHandlers:
         try:
             pctx = self.pc_builder.build(request)
         except Exception as e:  # noqa: BLE001
-            if prov_on:
-                provenance.record_decision(
-                    path='host_fallback', uid=uid, kind=kind,
-                    namespace=ns, name=request.get('name', '') or '',
-                    operation=request.get('operation', '') or '',
-                    duration_s=time.monotonic() - t_start,
-                    error=f'policy context build failed: {e}')
+            if prov_on or slo_on:
+                duration_s = time.monotonic() - t_start
+                slo.record('host_fallback', duration_s)
+                if prov_on:
+                    provenance.record_decision(
+                        path='host_fallback', uid=uid, kind=kind,
+                        namespace=ns,
+                        name=request.get('name', '') or '',
+                        operation=request.get('operation', '') or '',
+                        duration_s=duration_s,
+                        error=f'policy context build failed: {e}')
             return admission.response(uid, False,
                                       f'failed to build policy context: {e}')
         pctx.namespace_labels = self.namespace_labels(ns)
@@ -724,12 +730,18 @@ class ResourceHandlers:
         span = tracing.current_span()
         if span is not None:
             span.set_attribute('device_path', bool(use_device))
-        if prov_on:
-            provenance.record_decision(
-                path=prov_path, uid=uid, kind=kind, namespace=ns,
-                name=request.get('name', '') or '',
-                operation=request.get('operation', '') or '',
-                duration_s=time.monotonic() - t_start, **prov_extra)
+        if prov_on or slo_on:
+            duration_s = time.monotonic() - t_start
+            # feed the admission-latency SLO digest (shed:<reason>
+            # folds to the shed path inside record); no-op when the
+            # engine is off (KTPU_SLO_WINDOW_S=0)
+            slo.record(prov_path, duration_s)
+            if prov_on:
+                provenance.record_decision(
+                    path=prov_path, uid=uid, kind=kind, namespace=ns,
+                    name=request.get('name', '') or '',
+                    operation=request.get('operation', '') or '',
+                    duration_s=duration_s, **prov_extra)
         blocked = block_request(responses, failure_policy)
         if self.event_sink is not None and responses:
             # reference: handlers.go Validate -> webhooks/utils/event.go

@@ -11,6 +11,8 @@ Route table and lifecycle mirror the reference's server
   POST /verifymutate                lease heartbeat mutation
   GET  /health/liveness             liveness probe
   GET  /health/readiness            readiness probe
+  GET  /health                      aggregate health JSON (readiness +
+                                    warm-up + SLO verdict)
 
 TLS is loaded from cert/key PEM files when provided (the reference reads
 its pair per-handshake from the certmanager secret, server.go:155-177).
@@ -201,6 +203,23 @@ class WebhookServer:
         return (json.dumps(
             admission.review_response(request, resp)).encode('utf-8'), 200)
 
+    def health_status(self):
+        """(json body, http status) for the aggregate ``GET /health``:
+        readiness + warm-up state + the SLO verdict when the engine is
+        on.  The status code tracks readiness ONLY — a degraded SLO is
+        a payload-level signal for operators/alerting, never a reason
+        for the orchestrator to restart a pod that is still answering
+        admission requests (on the host loop if nothing else)."""
+        body = {'ready': self._ready}
+        w = self.warmer
+        if w is not None:
+            body['warmup'] = w.state
+        from ..observability import slo
+        verdict = slo.verdict()
+        if verdict is not None:
+            body['slo'] = verdict
+        return body, 200 if self._ready else 503
+
     def warmup_status(self):
         """(json body, http status) for /health/warmup."""
         w = self.warmer
@@ -231,6 +250,18 @@ class WebhookServer:
                     self.send_response(200 if ok else 503)
                     self.end_headers()
                     self.wfile.write(b'ok' if ok else b'not ready')
+                    return
+                if self.path == '/health':
+                    # aggregate health JSON (readiness + warm-up + SLO
+                    # verdict); the byte contracts of /health/liveness
+                    # and /health/readiness above stay untouched
+                    body, code = server.health_status()
+                    payload = json.dumps(body).encode('utf-8')
+                    self.send_response(code)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                     return
                 if self.path == '/health/warmup':
                     # 200 once the warm pass finished (ready), was

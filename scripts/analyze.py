@@ -58,6 +58,9 @@ def main(argv=None) -> int:
                     help='print the generated KTPU_* README table')
     ap.add_argument('--span-table', action='store_true',
                     help='print the generated README span table')
+    ap.add_argument('--debug-table', action='store_true',
+                    help='print the generated README debug-endpoint '
+                         'table (profiling-server route registry)')
     ap.add_argument('--list-rules', action='store_true')
     args = ap.parse_args(argv)
 
@@ -67,6 +70,10 @@ def main(argv=None) -> int:
     if args.span_table:
         from kyverno_tpu.analysis.catalog_pass import render_span_table
         print(render_span_table())
+        return 0
+    if args.debug_table:
+        from kyverno_tpu.observability.profiling import render_debug_table
+        print(render_debug_table())
         return 0
     if args.list_rules:
         for rid in sorted(RULES):

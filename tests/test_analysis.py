@@ -497,6 +497,93 @@ def test_ktpu503_stale_allowlist_entry(tmp_path):
                for f in rep.active)
 
 
+def test_ktpu506_ms_into_seconds_metric(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, elapsed_ms):
+        reg.observe('kyverno_tpu_scan_duration_seconds', elapsed_ms)
+    """}, rules=['KTPU506'])
+    assert rule_ids(rep) == {'KTPU506'}
+    assert any('elapsed_ms' in f.message for f in rep.active)
+    # a /1000 conversion anywhere in the expression is the fix
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, elapsed_ms):
+        reg.observe('kyverno_tpu_scan_duration_seconds',
+                    elapsed_ms / 1000.0)
+    """}, rules=['KTPU506'])
+    assert not rep.active
+    # ... as is * 0.001
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, elapsed_ms):
+        reg.observe('kyverno_tpu_scan_duration_seconds',
+                    elapsed_ms * 0.001)
+    """}, rules=['KTPU506'])
+    assert not rep.active
+
+
+def test_ktpu506_one_level_binding_resolution(tmp_path):
+    # the ms value hides behind one local assignment (KTPU204 depth)
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, lat_ms):
+        value = lat_ms
+        reg.observe('kyverno_tpu_scan_duration_seconds', value)
+    """}, rules=['KTPU506'])
+    assert rule_ids(rep) == {'KTPU506'}
+    # the binding carries the conversion: clean
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, lat_ms):
+        value = lat_ms / 1000
+        reg.observe('kyverno_tpu_scan_duration_seconds', value)
+    """}, rules=['KTPU506'])
+    assert not rep.active
+    # a metric name flowing through a module constant still resolves
+    rep = run(tmp_path, {'a.py': """\
+    METRIC = 'kyverno_tpu_scan_duration_seconds'
+
+    def emit(reg, lat_ms):
+        reg.observe(METRIC, lat_ms)
+    """}, rules=['KTPU506'])
+    assert rule_ids(rep) == {'KTPU506'}
+
+
+def test_ktpu506_len_of_str_into_bytes_metric(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg):
+        body = 'x'.join(['a', 'b'])
+        reg.inc('kyverno_tpu_response_bytes_total', len(body))
+    """}, rules=['KTPU506'])
+    assert rule_ids(rep) == {'KTPU506'}
+    assert any('characters' in f.message for f in rep.active)
+    # len of the encoded payload measures the wire size: clean
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, body):
+        reg.inc('kyverno_tpu_response_bytes_total',
+                len(body.encode()))
+    """}, rules=['KTPU506'])
+    assert not rep.active
+    # an unresolvable bare name is not assumed to be a str
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, payload):
+        reg.inc('kyverno_tpu_response_bytes_total', len(payload))
+    """}, rules=['KTPU506'])
+    assert not rep.active
+
+
+def test_ktpu506_ignores_unitless_metrics_and_bucket_args(tmp_path):
+    # no unit suffix — nothing to check
+    rep = run(tmp_path, {'a.py': """\
+    def emit(reg, lat_ms):
+        reg.set_gauge('kyverno_tpu_admission_queue_depth', lat_ms)
+    """}, rules=['KTPU506'])
+    assert not rep.active
+    # register_histogram's second arg is buckets, not a measurement
+    rep = run(tmp_path, {'a.py': """\
+    def setup(reg, buckets_ms):
+        reg.register_histogram(
+            'kyverno_tpu_scan_duration_seconds', buckets_ms)
+    """}, rules=['KTPU506'])
+    assert not rep.active
+
+
 # -- KTPU504/505: span catalog -----------------------------------------------
 
 def test_ktpu504_positive_negative(tmp_path):
@@ -700,7 +787,8 @@ def test_rule_registry_complete():
                 'KTPU201', 'KTPU202', 'KTPU203', 'KTPU204', 'KTPU205',
                 'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
                 'KTPU401', 'KTPU402',
-                'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505'}
+                'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505',
+                'KTPU506'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid

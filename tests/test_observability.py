@@ -81,6 +81,55 @@ class TestMetrics:
         # clearing an unknown series is a no-op
         reg.clear_gauge('kyverno_policy_rule_info_total', rule='ghost')
 
+    def test_residency_gauges_reset_on_close(self):
+        """Marked residency gauges (queue depth, breaker state,
+        in-flight chunks) sweep to 0 on close; a drained server must
+        scrape as empty, not as its last sampled occupancy.  Unmarked
+        gauges keep their value; the series stays visible."""
+        reg = MetricsRegistry()
+        reg.set_gauge('kyverno_tpu_admission_queue_depth', 7.0)
+        reg.set_gauge('kyverno_tpu_breaker_state', 2.0, state='open')
+        reg.set_gauge('kyverno_tpu_device_batch_size', 64.0)
+        reg.mark_reset_on_close('kyverno_tpu_admission_queue_depth')
+        reg.mark_reset_on_close('kyverno_tpu_breaker_state')
+        reg.mark_reset_on_close('never_written_gauge')  # tolerated
+        reg.reset_residency_gauges()
+        assert reg.gauge_value(
+            'kyverno_tpu_admission_queue_depth') == 0.0
+        # every label series of a marked name sweeps
+        assert reg.gauge_value('kyverno_tpu_breaker_state',
+                               state='open') == 0.0
+        # non-residency gauges keep their last value
+        assert reg.gauge_value('kyverno_tpu_device_batch_size') == 64.0
+        # swept, not retracted: the 0 stays in exposition
+        assert 'kyverno_tpu_admission_queue_depth 0' in reg.render()
+
+    def test_serving_layers_mark_their_residency_gauges(self):
+        """The batcher, breaker board, and device pipeline each mark
+        their occupancy gauge at registration time — the shutdown
+        sweep in cmd/internal.Setup depends on it."""
+        from kyverno_tpu.observability import device as devtel
+        from kyverno_tpu.observability.metrics import set_global_registry
+        from kyverno_tpu.serving.batcher import (QUEUE_DEPTH,
+                                                 AdmissionBatcher)
+        from kyverno_tpu.serving.breaker import (BREAKER_STATE,
+                                                 BreakerRegistry)
+        reg = MetricsRegistry()
+        set_global_registry(reg)
+        try:
+            devtel.configure(reg)
+            batcher = AdmissionBatcher(window_ms=1, max_batch=1,
+                                       queue_cap=1)
+            batcher._registry()
+            batcher.stop()
+            BreakerRegistry(failure_limit=1).record_failure(
+                ('fp',), [], 'boom')
+        finally:
+            set_global_registry(None)
+            devtel.disable()
+        assert {QUEUE_DEPTH, BREAKER_STATE,
+                devtel.PIPELINE_INFLIGHT} <= reg._reset_on_close
+
     def test_histogram_bucket_override(self):
         """Compile-scale samples (43-49s fresh-cache compiles) must land
         in real buckets, not +Inf — per-histogram overrides up to 120s."""

@@ -1,0 +1,321 @@
+"""Serving SLO engine (ISSUE 14 tentpole b).
+
+Multi-window burn-rate math over the sliding time-ring digests, the
+degraded-transition auto-profile (exactly one, rate-limited), the
+``KTPU_SLO_WINDOW_S=0`` off-state bit-identity on the admission path,
+the aggregate ``GET /health`` verdict payload, and the acceptance
+drill: a fault-injected slow handler crossing the burn threshold fires
+exactly one auto-profile.  CPU-only, tier-1.
+"""
+
+import json
+
+import yaml
+
+from kyverno_tpu import faults
+from kyverno_tpu.api.policy import Policy
+from kyverno_tpu.config.config import Configuration
+from kyverno_tpu.observability import executables, slo
+from kyverno_tpu.observability.metrics import MetricsRegistry
+from kyverno_tpu.observability.slo import (BURN_DEGRADED,
+                                           PROFILE_MIN_INTERVAL_S,
+                                           SLO_BUDGET_REMAINING,
+                                           SLO_BURN_RATE, SloEngine)
+from kyverno_tpu.policycache.cache import Cache
+from kyverno_tpu.webhooks.handlers import ResourceHandlers
+from kyverno_tpu.webhooks.server import WebhookServer
+
+ENFORCE_POLICY = """
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: require-team
+  annotations: {pod-policies.kyverno.io/autogen-controllers: none}
+spec:
+  validationFailureAction: enforce
+  rules:
+    - name: require-team
+      match: {any: [{resources: {kinds: [Pod]}}]}
+      validate:
+        message: "label 'team' is required"
+        pattern:
+          metadata:
+            labels:
+              team: "?*"
+"""
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_modules():
+    yield
+    slo.disable()
+    executables.disable()
+    faults.disable()
+
+
+class FakeClock:
+    def __init__(self, t=10_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_engine(window_s=120.0, p99_ms=100.0, target=0.9,
+                registry=None, profile_trigger=None):
+    clock = FakeClock()
+    eng = SloEngine(window_s=window_s, p99_ms=p99_ms, target=target,
+                    registry=registry or MetricsRegistry(), now=clock,
+                    profile_trigger=profile_trigger or (lambda: None))
+    return eng, clock
+
+
+def make_cache(*policy_yamls):
+    cache = Cache()
+    policies = [Policy(d) for y in policy_yamls
+                for d in yaml.safe_load_all(y)]
+    cache.warm_up(policies)
+    return cache
+
+
+def pod(labels=None, name='test-pod'):
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': name, 'namespace': 'default',
+                         'labels': labels or {}},
+            'spec': {'containers': [{'name': 'c', 'image': 'nginx'}]}}
+
+
+def review(resource, uid='uid-1'):
+    return json.dumps({
+        'apiVersion': 'admission.k8s.io/v1', 'kind': 'AdmissionReview',
+        'request': {
+            'uid': uid,
+            'kind': {'group': '', 'version': 'v1',
+                     'kind': resource.get('kind', '')},
+            'namespace': resource['metadata'].get('namespace', ''),
+            'name': resource['metadata'].get('name', ''),
+            'operation': 'CREATE',
+            'object': resource,
+            'userInfo': {'username': 'alice', 'groups': []},
+        }}).encode()
+
+
+class TestBurnMath:
+    def test_within_objective_burns_nothing(self):
+        eng, _ = make_engine()
+        for _ in range(20):
+            eng.record('batch', 0.010)  # 10ms < 100ms objective
+        v = eng.verdict()
+        assert v['burn_rate_long'] == 0.0
+        assert v['burn_rate_short'] == 0.0
+        assert v['budget_remaining'] == 1.0
+        assert v['degraded'] is False
+
+    def test_all_over_objective_burns_at_inverse_budget(self):
+        # target 0.9 → budget 0.1; 100% over-objective → burn 10.0
+        eng, _ = make_engine()
+        for _ in range(10):
+            eng.record('sync', 0.500)
+        v = eng.verdict()
+        assert abs(v['burn_rate_long'] - 10.0) < 1e-9
+        assert abs(v['burn_rate_short'] - 10.0) < 1e-9
+        assert v['degraded'] is True
+
+    def test_degraded_requires_both_windows(self):
+        # old slices carry the errors; the current (short) slice is
+        # clean → the long window burns but the verdict holds
+        eng, clock = make_engine()
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        clock.advance(eng.slice_s * 2)
+        for _ in range(40):
+            eng.record('batch', 0.001)
+        v = eng.verdict()
+        assert v['burn_rate_short'] == 0.0
+        assert v['burn_rate_long'] >= BURN_DEGRADED
+        # a fresh recording recomputes the flag from both windows
+        eng.record('batch', 0.001)
+        assert eng.verdict()['degraded'] is False
+
+    def test_window_expiry_forgets_old_slices(self):
+        eng, clock = make_engine()
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        clock.advance(eng.window_s + eng.slice_s)
+        eng.record('batch', 0.001)
+        v = eng.verdict()
+        assert v['burn_rate_long'] == 0.0
+        assert v['budget_remaining'] == 1.0
+
+    def test_gauges_published(self):
+        reg = MetricsRegistry()
+        eng, _ = make_engine(registry=reg)
+        eng.record('batch', 0.500)
+        assert reg.gauge_value(SLO_BURN_RATE, window='long') == 10.0
+        assert reg.gauge_value(SLO_BURN_RATE, window='short') == 10.0
+        assert reg.gauge_value(SLO_BUDGET_REMAINING) == -9.0
+
+    def test_snapshot_per_path_digests(self):
+        eng, _ = make_engine()
+        for _ in range(98):
+            eng.record('batch', 0.004)
+        eng.record('batch', 0.900)
+        eng.record('batch', 0.900)
+        eng.record('shed', 0.020)
+        snap = eng.snapshot()
+        assert set(snap['paths']) == {'batch', 'shed'}
+        b = snap['paths']['batch']
+        assert b['count'] == 100 and b['over_objective'] == 2
+        # upper-bound bucket estimates: p50 in the 5ms bucket, p99
+        # reaches the 1000ms bucket holding the one slow decision
+        assert b['p50_ms'] == 5.0
+        assert b['p99_ms'] == 1000.0
+
+
+class TestAutoProfile:
+    def test_exactly_one_profile_on_transition(self):
+        fired = []
+        eng, clock = make_engine(profile_trigger=lambda: fired.append(1))
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        assert eng.auto_profiles == 1
+        # still degraded: no re-fire while the verdict holds
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        assert eng.auto_profiles == 1
+
+    def test_rate_limit_holds_across_flaps(self):
+        eng, clock = make_engine()
+        eng.profile_trigger = lambda: None
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        assert eng.auto_profiles == 1
+        # recover (clean slice), then degrade again inside the 60s
+        # floor: the transition happens but the capture is suppressed
+        clock.advance(eng.slice_s)
+        eng.record('batch', 0.001)
+        assert eng.verdict()['degraded'] is False
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        assert eng.verdict()['degraded'] is True
+        assert eng.auto_profiles == 1
+        # past the floor, a fresh transition captures again
+        clock.advance(PROFILE_MIN_INTERVAL_S + eng.slice_s)
+        eng.record('batch', 0.001)
+        for _ in range(10):
+            eng.record('batch', 0.500)
+        assert eng.auto_profiles == 2
+
+
+class TestModuleState:
+    def test_noop_until_configured(self):
+        assert not slo.enabled()
+        slo.record('batch', 99.0)  # must not raise
+        assert slo.verdict() is None
+        assert slo.snapshot() == {}
+
+    def test_env_window_zero_disables(self, monkeypatch):
+        monkeypatch.delenv('KTPU_SLO_WINDOW_S', raising=False)
+        assert slo.configure(registry=MetricsRegistry()) is None
+        monkeypatch.setenv('KTPU_SLO_WINDOW_S', '0')
+        assert slo.configure(registry=MetricsRegistry()) is None
+        assert not slo.enabled()
+
+    def test_env_knobs_shape_the_engine(self, monkeypatch):
+        monkeypatch.setenv('KTPU_SLO_WINDOW_S', '240')
+        monkeypatch.setenv('KTPU_SLO_P99_MS', '50')
+        monkeypatch.setenv('KTPU_SLO_TARGET', '0.95')
+        eng = slo.configure(registry=MetricsRegistry())
+        assert eng.window_s == 240.0
+        assert eng.objective_ms == 50.0
+        assert eng.target == 0.95
+        assert slo.enabled()
+
+    def test_shed_reason_folds_to_lane(self):
+        eng = slo.configure(registry=MetricsRegistry(), window_s=60.0,
+                            p99_ms=100.0, target=0.9)
+        slo.record('shed:queue_full', 0.001)
+        assert set(eng.snapshot()['paths']) == {'shed'}
+
+
+class TestAdmissionIntegration:
+    def _serve(self):
+        handlers = ResourceHandlers(make_cache(ENFORCE_POLICY),
+                                    device=False)
+        return WebhookServer(handlers, configuration=Configuration())
+
+    def test_off_state_is_bit_identical(self):
+        """KTPU_SLO_WINDOW_S=0 (and the executables ledger off): the
+        admission response bytes are identical to a run with both
+        enabled — telemetry never reaches the payload."""
+        server = self._serve()
+        body_off = server.handle('/validate/fail',
+                                 review(pod(), uid='u-bit'))
+        slo.configure(registry=MetricsRegistry(), window_s=60.0,
+                      p99_ms=100.0, target=0.9)
+        executables.configure(registry=MetricsRegistry(), ledger_n=16)
+        body_on = server.handle('/validate/fail',
+                                review(pod(), uid='u-bit'))
+        assert body_on == body_off
+        # ...and the engine really observed the decision
+        snap = slo.snapshot()
+        assert sum(p['count'] for p in snap['paths'].values()) == 1
+
+    def test_handler_feeds_serving_path(self):
+        eng = slo.configure(registry=MetricsRegistry(), window_s=60.0,
+                            p99_ms=10_000.0, target=0.9)
+        server = self._serve()
+        server.handle('/validate/fail', review(pod()))
+        snap = eng.snapshot()
+        assert snap['paths'], snap
+        assert not snap['degraded']
+
+    def test_health_carries_verdict_payload_only(self):
+        server = self._serve()
+        body, code = server.health_status()
+        assert 'slo' not in body  # engine off → no verdict key
+        slo.configure(registry=MetricsRegistry(), window_s=60.0,
+                      p99_ms=0.0001, target=0.9)
+        for _ in range(5):
+            server.handle('/validate/fail', review(pod()))
+        body, code = server.health_status()
+        assert body['slo']['degraded'] is True
+        # degraded never changes the status code: readiness only
+        assert code == (200 if body['ready'] else 503)
+
+    def test_burn_crossing_fires_one_auto_profile(self):
+        """ISSUE 14 acceptance: a fault-injected slow handler (device
+        path raises → every request host-fallbacks past a microscopic
+        objective) crosses the burn threshold and fires exactly one
+        rate-limited auto-profile."""
+        fired = []
+        slo.configure(registry=MetricsRegistry(), window_s=600.0,
+                      p99_ms=0.0001, target=0.99,
+                      profile_trigger=lambda: fired.append(1))
+        faults.configure('site=webhook_handler,p=1')
+        handlers = ResourceHandlers(make_cache(ENFORCE_POLICY),
+                                    device=True)
+        server = WebhookServer(handlers, configuration=Configuration())
+        inj = faults.active()
+        for k in range(8):
+            body = server.handle('/validate/fail',
+                                 review(pod(), uid=f'u{k}'))
+            assert json.loads(body)['response']  # served, not 500
+        assert inj.counts().get('webhook_handler', 0) >= 1
+        eng = slo.engine()
+        assert eng.verdict()['degraded'] is True
+        snap = eng.snapshot()
+        assert 'host_fallback' in snap['paths']
+        assert eng.auto_profiles == 1
+        # the capture thread is fire-and-forget; join via the counter
+        import time as _time
+        deadline = _time.time() + 5.0
+        while not fired and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert len(fired) == 1
