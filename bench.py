@@ -865,6 +865,48 @@ def _overlap_block(before: dict, after: dict, wall_s: float) -> dict:
     return out
 
 
+def _critical_path_block(blame_before: dict, wall_s: float,
+                         trace_name: str = 'northstar'):
+    """Critical-path blame delta over one measured window: exclusive
+    per-stage blame seconds (they sum to the scans' wall, unlike the
+    overlap ratios), the bottleneck verdict, and the advisor's knob
+    suggestion.  Also drops a Perfetto-loadable Chrome trace of the
+    recorder's recent scans (path in ``trace_file``).  None when the
+    timeline recorder is off (``KTPU_TIMELINE=0``)."""
+    from kyverno_tpu.observability import timeline as _timeline
+    rec = _timeline.recorder()
+    if rec is None:
+        return None
+    blame = {}
+    for stage, t1 in rec.blame_totals().items():
+        d = t1 - blame_before.get(stage, 0.0)
+        if d > 0:
+            blame[stage] = d
+    total = sum(blame.values())
+    frac = {s: round(v / total, 4) for s, v in blame.items()} \
+        if total > 0 else {}
+    bound_by = max(blame, key=lambda s: blame[s]) if blame else ''
+    suggest, note = _timeline.advise(bound_by, frac.get(bound_by, 0.0))
+    path = os.environ.get('BENCH_TIMELINE_TRACE') or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), '.cache', 'timeline',
+        f'{trace_name}-trace.json')
+    try:
+        trace_file = _timeline.dump_chrome_trace(path)
+    except OSError:
+        trace_file = None
+    return {
+        'blame_s': {s: round(v, 4) for s, v in blame.items()},
+        'blame_frac': frac,
+        'wall_s': round(wall_s, 2),
+        'wall_coverage': round(total / wall_s, 4) if wall_s > 0 else 0.0,
+        'bound_by': bound_by,
+        'suggest': suggest,
+        'note': note,
+        'scans': rec.n_scans,
+        'trace_file': trace_file,
+    }
+
+
 def run_bench(n: int, platform: str, budget_s: float) -> dict:
     """Time-boxed north-star run: stream synthetic Pods through the
     report path until ``budget_s`` of measured streaming wall-clock is
@@ -884,6 +926,12 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     # dispatch the bench triggers lands in the census block below
     from kyverno_tpu.observability import executables as _exec
     _exec.configure(ledger_n=256)
+
+    # per-chunk stage timeline + critical-path blame over the streaming
+    # window (the critical_path block below); KTPU_TIMELINE=0 disables
+    from kyverno_tpu.observability import timeline as _timeline
+    if _timeline.recorder() is None:
+        _timeline.configure()
 
     t0 = time.time()
     _progress('compiling policy set')
@@ -924,6 +972,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
                          for i in scanner._host_policy_idx}
     rss_before_mb = _current_rss_mb()
     stage_before = _stage_totals()
+    blame_before = _timeline.blame_totals()  # excludes the warm scan
     slab = 4 * scanner.CHUNK
     decisions = 0
     compiled_decisions = 0
@@ -970,6 +1019,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
     rate = decisions / e2e_s if e2e_s > 0 else 0.0
     rss_block = rss_sampler.block(rss_before_mb, n_done)
     overlap_block = _overlap_block(stage_before, _stage_totals(), e2e_s)
+    cp_block = _critical_path_block(blame_before, e2e_s)
 
     # the raw status sieve (no response objects) on a bounded sample —
     # the ROADMAP ratchet pins streaming e2e ≥ this in-scan sieve rate
@@ -1018,6 +1068,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
             'rss_before_scan_mb': round(rss_before_mb, 1),
             'rss': rss_block,
             'streaming_overlap': overlap_block,
+            'critical_path': cp_block,
             'sieve_n': sieve_n,
             'sieve_decisions_per_sec': round(sieve_rate, 1),
             'e2e_vs_sieve': round(e2e_vs_sieve, 3)
@@ -1157,6 +1208,7 @@ def run_bench(n: int, platform: str, budget_s: float) -> dict:
         'warm': warm_block,
         'rss': rss_block,
         'streaming_overlap': overlap_block,
+        'critical_path': cp_block,
         'sieve_n': sieve_n,
         'sieve_decisions_per_sec': round(sieve_rate, 1),
         'e2e_vs_sieve': round(e2e_vs_sieve, 3)
@@ -1917,6 +1969,9 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
         return round(s[min(len(s) - 1, int(len(s) * q))], 3)
 
     _progress(f'rescan churn bench: {n} rows, {ticks} ticks @ {ratio}')
+    from kyverno_tpu.observability import timeline as _timeline
+    if _timeline.recorder() is None:
+        _timeline.configure()
     ctrl = _churn_controller(policies, resources, cache_dir, enabled=True)
     rss_before = _current_rss_mb()
     with RssSampler() as rss_sampler:
@@ -1924,6 +1979,7 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
         ctrl.enqueue_all()
         ctrl.reconcile()  # cold tick: populate the cache
         cold_s = time.time() - t0
+        blame_before = _timeline.blame_totals()  # delta = cached ticks
         lat, scanned, replayed = run_ticks(ctrl, ticks)
     total = [s + r for s, r in zip(scanned, replayed)]
     scanned_ratio = sum(scanned) / max(sum(total), 1)
@@ -1931,6 +1987,9 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
     # O(reports) by design — the ratchet still bounds regression toward
     # re-materializing all N decoded rows per tick
     rss_block = rss_sampler.block(rss_before, n)
+    # blame the cached ticks only — snapshot before the dense baseline
+    cp_block = _critical_path_block(blame_before, sum(lat),
+                                    trace_name='rescan')
 
     _progress(f'rescan dense baseline: {dense_ticks} tick(s)')
     dense = _churn_controller(policies, resources, cache_dir,
@@ -1955,6 +2014,7 @@ def run_rescan_churn(platform: str, n: Optional[int] = None,
                                                1e-9), 2),
         'cache': dict(ctrl.verdict_cache.stats())
         if ctrl.verdict_cache is not None else None,
+        'critical_path': cp_block,
     }
     from kyverno_tpu.observability import device as device_telemetry
     reg = device_telemetry.registry()

@@ -28,6 +28,14 @@ module API, and exit codes are unchanged).
   starts — the span analogue of KTPU503, so the README span table
   (generated from the same catalog) can never document spans that no
   longer exist.
+* **KTPU507** — pipeline stage-label drift: a ``stage('<s>')`` /
+  ``exec_scope`` / ``ChunkPipeline`` stage-list / ``add_backpressure``
+  label used under ``compiler/`` that is not registered in
+  ``observability/catalog.py:PIPELINE_STAGES`` (the timeline
+  critical-path walk and the blame metric group by registered names,
+  so an unregistered label silently drops out of attribution), or a
+  registered stage with no use site anywhere in the tree (dead-stage
+  check, the KTPU503/505 analogue).
 * **KTPU506** — unit mismatch at a write site: a cataloged metric whose
   name declares its unit (``*_seconds[_total]`` / ``*_bytes[_total]``)
   is fed a value that carries the wrong one — a ``*_ms`` name with no
@@ -327,6 +335,96 @@ def _check_dead_spans(ctx: Context) -> Iterable[Finding]:
             'KTPU505', line,
             f'span catalog: {name!r} has no start site in the tree — '
             f'remove the entry or add the span')
+
+
+# -- pipeline stage registry (KTPU507) ----------------------------------------
+
+def load_stage_registry() -> Dict[str, str]:
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from kyverno_tpu.observability.catalog import PIPELINE_STAGES
+    return dict(PIPELINE_STAGES)
+
+
+def collect_stage_labels(files: List[SourceFile]
+                         ) -> List[Tuple[SourceFile, int, str]]:
+    """Pipeline stage-label sites across a parsed file set:
+    ``stage('<s>')`` timers, ``add_backpressure('<s>', ...)``
+    attributions, ``exec_scope(tl, c, '<s>')`` inline wrappers, and the
+    literal ``(name, fn)`` stage lists handed to ``ChunkPipeline``.
+    Non-literal labels are skipped (variables flow from these same
+    literal surfaces)."""
+    sites: List[Tuple[SourceFile, int, str]] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else \
+                (func.id if isinstance(func, ast.Name) else '')
+            if attr in ('stage', 'add_backpressure'):
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    sites.append((sf, node.lineno, arg.value))
+            elif attr == 'exec_scope' and len(node.args) >= 3:
+                arg = node.args[2]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    sites.append((sf, node.lineno, arg.value))
+            elif attr == 'ChunkPipeline':
+                arg = node.args[0]
+                if isinstance(arg, (ast.List, ast.Tuple)):
+                    for elt in arg.elts:
+                        if isinstance(elt, ast.Tuple) and elt.elts and \
+                                isinstance(elt.elts[0], ast.Constant) and \
+                                isinstance(elt.elts[0].value, str):
+                            sites.append((sf, elt.lineno,
+                                          elt.elts[0].value))
+    return sites
+
+
+@register('KTPU507', 'pipeline stage label in compiler/ missing from '
+                     'the stage registry (catalog PIPELINE_STAGES), '
+                     'or a registered stage no code uses')
+def _check_stage_labels(ctx: Context) -> Iterable[Finding]:
+    registry = load_stage_registry()
+    sites = collect_stage_labels(ctx.files)
+    for sf, line, label in sites:
+        if label in registry:
+            continue
+        rel = '/' + sf.rel.replace(os.sep, '/')
+        if '/compiler/' in rel:
+            yield sf.finding(
+                'KTPU507', line,
+                f'stage label {label!r} is not a registered pipeline '
+                f'stage (observability/catalog.py PIPELINE_STAGES) — '
+                f'register it, or the timeline critical-path walk and '
+                f'the blame metric silently drop its intervals')
+    used = {label for _sf, _l, label in sites}
+    anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
+
+    def locate(name):
+        target = anchor if anchor is not None else ctx.files[0]
+        line = 1
+        if anchor is not None:
+            for i, text in enumerate(anchor.lines, start=1):
+                if f"'{name}'" in text:
+                    line = i
+                    break
+        return target, line
+
+    for name in sorted(registry):
+        if name in used:
+            continue
+        target, line = locate(name)
+        yield target.finding(
+            'KTPU507', line,
+            f'stage registry: {name!r} has no stage()/exec_scope/'
+            f'ChunkPipeline/add_backpressure site in the tree — '
+            f'remove the entry or add the stage')
 
 
 # -- unit-mismatch pass (KTPU506) ---------------------------------------------

@@ -92,9 +92,23 @@ def _encode_worker(args):
     if _ENCODER_PALETTES is None:
         from .encode import LaneArena
         _ENCODER_PALETTES = LaneArena(max_pool=0)
-    batch = encode_batch(docs, _ENCODER_CPS, padded_n=padded_n,
-                         contexts=contexts, arena=_ENCODER_PALETTES)
-    return batch.tensors()
+    # the fork inherits the parent's telemetry globals, but its metric
+    # increments and contextvars die with the process — the pipeline
+    # threads re-install the scan's ScanCapture, and this is the
+    # process-side analogue: measure into a fresh local capture and
+    # ship the stage seconds (plus the wall interval, for the
+    # timeline) home with the tensors; the resolving pipeline thread
+    # re-attributes them via devtel.merge_worker_stages.
+    from ..observability import device as devtel
+    cap = devtel.ScanCapture()
+    t0 = time.monotonic()
+    with devtel.install_capture(cap):
+        batch = encode_batch(docs, _ENCODER_CPS, padded_n=padded_n,
+                             contexts=contexts, arena=_ENCODER_PALETTES)
+    t1 = time.monotonic()
+    cap.add('encode', t1 - t0)
+    return batch.tensors(), dict(cap.stages), \
+        (t0, t1, __import__('os').getpid())
 
 
 class _EncoderPool:
@@ -566,7 +580,7 @@ class BatchScanner:
                               contexts: Optional[List[dict]] = None,
                               match: Optional[np.ndarray] = None,
                               adm_plan: Optional[Any] = None,
-                              match_fn=None):
+                              match_fn=None, timeline=None):
         """Yield ``(start, status, detail, fdet, adm, chunk_match)`` per
         fixed-size chunk; ``adm`` is the device's per-row
         admission-match decision for the eligible program columns (None
@@ -596,6 +610,7 @@ class BatchScanner:
             yield 0, z, z, z.astype(np.int32), None, zm
             return
         from ..observability import device as devtel
+        from ..observability import timeline as tlmod
         from ..observability import tracing
         from ..ops.eval import expand_compact, shard_batch
         from .pipeline import ChunkPipeline
@@ -683,12 +698,27 @@ class BatchScanner:
                         p['part'], p['part_ctx'], p['bucket'])
                 else:
                     try:
-                        tensors = tensors.get(timeout=self.ENCODE_TIMEOUT_S)
+                        tensors, wstages, wspan = tensors.get(
+                            timeout=self.ENCODE_TIMEOUT_S)
                     except Exception:  # noqa: BLE001 - worker death
                         self._encoder_pool.close()
                         self._encoder_pool._broken = True
                         tensors, p['batch'] = inline_encode(
                             p['part'], p['part_ctx'], p['bucket'])
+                    else:
+                        # stage seconds measured inside the forked
+                        # worker: fold into the parent's histogram and
+                        # the ambient ScanCapture (installed on this
+                        # pipeline thread), and pin the worker's wall
+                        # interval on the timeline with its process
+                        # identity — fork workers share the parent's
+                        # monotonic clock on Linux
+                        devtel.merge_worker_stages(wstages)
+                        if timeline is not None and wspan is not None:
+                            timeline.record(
+                                'encode', start // chunk, wspan[0],
+                                wspan[1],
+                                thread='ktpu-encproc-%d' % wspan[2])
             cm = p['cm']
             if cm is not None and self.mesh is None and tensors:
                 from ..ops.eval import fold_match_unique
@@ -783,8 +813,14 @@ class BatchScanner:
                         parent=tel_parent):
                 p = None
                 try:
-                    p = stage_encode(0)
-                    result = stage_d2h(stage_eval(stage_h2d(p)))
+                    with tlmod.exec_scope(timeline, 0, 'encode'):
+                        p = stage_encode(0)
+                    with tlmod.exec_scope(timeline, 0, 'h2d'):
+                        p = stage_h2d(p)
+                    with tlmod.exec_scope(timeline, 0, 'device_eval'):
+                        p = stage_eval(p)
+                    with tlmod.exec_scope(timeline, 0, 'd2h'):
+                        result = stage_d2h(p)
                 except BaseException:
                     # the inline path has no pipeline cleanup hook: a
                     # stage crash must still hand the chunk's encode
@@ -798,7 +834,7 @@ class BatchScanner:
             [('encode', stage_encode), ('h2d', stage_h2d),
              ('device_eval', stage_eval), ('d2h', stage_d2h)],
             capture=tel_capture, parent_span=tel_parent,
-            cleanup=release_chunk)
+            cleanup=release_chunk, timeline=timeline)
         yield from pipe.run(range(0, n, chunk))
 
     def _device_statuses(self, resources: List[dict],
@@ -932,9 +968,12 @@ class BatchScanner:
         # the with-block): holding one span across yields would leak the
         # current-span contextvar into the consumer and record a bogus
         # error when the consumer stops iterating early
+        from ..observability import timeline as tlmod
         from ..observability import tracing
+        tl = tlmod.begin_scan()
+        chunk_cap = max(self.CHUNK, 1)
         chunks = self._device_status_chunks(resources, contexts, match,
-                                            adm_plan=plan)
+                                            adm_plan=plan, timeline=tl)
         tally = coverage.scan_tally()
         start = 0
         try:
@@ -959,6 +998,7 @@ class BatchScanner:
                                 adm_out[vr].astype(bool)
                     span.set_attribute('resources', status.shape[0])
                     from ..observability import device as devtel
+                    t_rep = time.monotonic() if tl is not None else 0.0
                     with devtel.stage('report',
                                       {'rows': status.shape[0]}) as rstage:
                         chunk_rows = self._assemble_chunk(
@@ -976,6 +1016,8 @@ class BatchScanner:
                                 span.set_attribute(
                                     'device_coverage_ratio',
                                     round(ratio, 4))
+                    if tl is not None:
+                        tl.record('report', start // chunk_cap, t_rep)
                 start += status.shape[0]
                 yield from chunk_rows
         finally:
@@ -988,6 +1030,11 @@ class BatchScanner:
                 cap = devtel.current_capture()
                 if cap is not None:
                     cap.coverage_ratio = tally.ratio()
+            # tear the pipeline down BEFORE finalizing the timeline:
+            # close_open/drain must have run so the blame walk sees
+            # every interval closed (deterministic on early close too)
+            chunks.close()
+            tlmod.finish_scan(tl)
 
     def _assemble_chunk(self, resources, wrapped, match, start, status,
                         detail, fdet, now, ts, background_mode,
@@ -1359,8 +1406,12 @@ class BatchScanner:
             # mask and Resource list never exist
             return self.match_matrix(part, [Resource(r) for r in part])
 
+        from ..observability import timeline as tlmod
+        tl = tlmod.begin_scan()
+        chunk_cap = max(self.CHUNK, 1)
         chunks = self._device_status_chunks(resources, None,
-                                            match_fn=match_fn)
+                                            match_fn=match_fn,
+                                            timeline=tl)
         tally = coverage.scan_tally()
         flush = max(1, self.REPORT_FLUSH_ROWS)
         host_idx = [p_idx for p_idx in self._host_policy_idx
@@ -1383,6 +1434,7 @@ class BatchScanner:
                 for w0 in range(0, m, flush):
                     w1 = min(w0 + flush, m)
                     wm = w1 - w0
+                    t_rep = time.monotonic() if tl is not None else 0.0
                     with devtel.stage('report', {'rows': wm}) as rstage:
                         rows, row_pols, counts = \
                             self._assemble_report_window(
@@ -1396,6 +1448,8 @@ class BatchScanner:
                                 rstage.set_attribute(
                                     'device_coverage_ratio',
                                     round(ratio, 4))
+                    if tl is not None:
+                        tl.record('report', start // chunk_cap, t_rep)
                     for k in range(wm):
                         i = start + w0 + k
                         results = rows[k]
@@ -1442,6 +1496,10 @@ class BatchScanner:
                 cap = devtel.current_capture()
                 if cap is not None:
                     cap.coverage_ratio = tally.ratio()
+            # pipeline teardown first (close_open/drain), then the
+            # blame walk — see _scan_inner
+            chunks.close()
+            tlmod.finish_scan(tl)
 
     def _cell(self, prog, j: int, st: int, det: int, fdet_row, ts: int,
               fly: Dict[Tuple, Any], resource: Optional[dict] = None,

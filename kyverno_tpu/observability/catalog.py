@@ -170,6 +170,12 @@ METRICS: Dict[str, Metric] = {
     'kyverno_tpu_executable_device_seconds_total': Metric(
         'counter', 'Cumulative device-eval seconds spent per '
         'executable acquisition source.'),
+    # pipeline critical-path observatory (observability/timeline.py)
+    'kyverno_tpu_pipeline_blame_seconds_total': Metric(
+        'counter', 'Exclusive critical-path blame per streaming-scan '
+        'stage: seconds of scan wall the timeline walk attributed to '
+        'stage= (executing or gated-waiting while on the e2e critical '
+        'path); per-scan fractions drive the bottleneck advisor.'),
     # serving SLO engine (observability/slo.py)
     'kyverno_tpu_slo_burn_rate': Metric(
         'gauge', 'Admission-latency error-budget burn rate '
@@ -218,4 +224,24 @@ SPANS: Dict[str, str] = {
                                   '(build/evict) as a zero-duration '
                                   'span; the JSONL trace exporter is '
                                   'the lifecycle log.',
+}
+
+
+#: canonical streaming-pipeline stage labels — the single source of
+#: truth for every ``stage('<s>')`` timer, ``ChunkPipeline`` stage-list
+#: entry, and backpressure attribution in the tree (ktpu-lint KTPU507:
+#: an unregistered label under ``compiler/`` or a dead registry entry
+#: is catalog drift).  The timeline recorder and its critical-path
+#: blame walk (observability/timeline.py) group events by these names.
+PIPELINE_STAGES: Dict[str, str] = {
+    'intake': 'Feeder admission into the streaming pipeline (chunk '
+              'slot acquire + first-queue handoff).',
+    'pack': 'Pack-plan build.',
+    'encode': 'Host feature extraction (columnar lane encode, inline '
+              'or forked worker).',
+    'h2d': 'Host-to-device transfer (and forked-encode resolution).',
+    'compile': 'Executable lookup / XLA compile.',
+    'device_eval': 'Device evaluation dispatch.',
+    'd2h': 'Device-to-host readback (stall-watchdog armed).',
+    'report': 'Report-row assembly / flush window.',
 }

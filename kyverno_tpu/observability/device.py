@@ -139,12 +139,16 @@ class ScanCapture:
     without attributing concurrent scans' stages to each other the way
     a registry-sum delta would."""
 
-    __slots__ = ('stages', 'aot', 'coverage_ratio', '_lock')
+    __slots__ = ('stages', 'aot', 'coverage_ratio', 'critical_path',
+                 '_lock')
 
     def __init__(self):
         self.stages: Dict[str, float] = {}
         self.aot = ''
         self.coverage_ratio: Optional[float] = None
+        #: critical-path blame summary for this scan, filled by the
+        #: timeline recorder (observability/timeline.py) when armed
+        self.critical_path: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
 
     def add(self, stage: str, seconds: float) -> None:
@@ -183,6 +187,23 @@ def install_capture(capture: Optional[ScanCapture]) -> _CaptureScope:
 
 def current_capture() -> Optional[ScanCapture]:
     return _capture_var.get()
+
+
+def merge_worker_stages(stages: Dict[str, float]) -> None:
+    """Fold stage seconds measured inside a forked encode worker into
+    the parent's telemetry: the stage histogram and the ambient
+    ScanCapture.  Worker processes inherit telemetry globals at fork
+    but their metric increments and contextvars die with them — the
+    measured times ride home with the encoded tensors and are
+    re-attributed here, on the pipeline thread that resolved them."""
+    if not stages:
+        return
+    capture = _capture_var.get()
+    for name, seconds in stages.items():
+        if _registry is not None:
+            _registry.observe(SCAN_STAGE_DURATION, seconds, stage=name)
+        if capture is not None:
+            capture.add(name, seconds)
 
 
 # -- stage timers -----------------------------------------------------------

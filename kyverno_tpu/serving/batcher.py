@@ -321,11 +321,16 @@ class AdmissionBatcher:
                     'kyverno/serving/batch',
                     {'occupancy': len(batch),
                      'window_ms': self.window_s * 1000.0},
-                    parent=lead.span):
+                    parent=lead.span) as bspan:
             faults.check_rows(faults.SITE_BATCHER_DISPATCH, resources)
             rows = scanner.scan(resources, contexts=contexts,
                                 admission=lead.admission,
                                 pctx_factory=pctx_factory, **extra)
+            if cap is not None and cap.critical_path is not None:
+                from ..observability import timeline as tlmod
+                bspan.set_attribute(
+                    'critical_path',
+                    tlmod.format_summary(cap.critical_path))
         if cap is not None:
             device_eval_s = cap.stage_s('device_eval')
             share = device_eval_s / len(batch)

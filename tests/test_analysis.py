@@ -645,6 +645,68 @@ def test_ktpu505_positive_negative(tmp_path):
     assert not rep.active
 
 
+def _stage_registry_uses():
+    """One ``stage('<s>')`` site per registered pipeline stage — the
+    clean-state floor for KTPU507 fixtures (mirrors how the KTPU503
+    negative writes every cataloged metric)."""
+    from kyverno_tpu.analysis.catalog_pass import load_stage_registry
+    return 'def _uses(devtel):\n' + ''.join(
+        f"    devtel.stage('{name}')\n"
+        for name in sorted(load_stage_registry()))
+
+
+def test_ktpu507_unregistered_stage_in_compiler(tmp_path):
+    rep = run(tmp_path, {
+        'compiler/c.py': """\
+        def f(devtel):
+            with devtel.stage('warp'):
+                pass
+        """,
+        'u.py': _stage_registry_uses(),
+    }, rules=['KTPU507'])
+    assert rule_ids(rep) == {'KTPU507'}
+    assert any("'warp'" in f.message for f in rep.active)
+    # the same label registered (plus a use per registry entry) is clean
+    rep = run(tmp_path, {'compiler/c.py': _stage_registry_uses()},
+              rules=['KTPU507'])
+    assert not rep.active
+
+
+def test_ktpu507_outside_compiler_is_not_flagged(tmp_path):
+    # engine-side stage timers are not pipeline stages — the
+    # unregistered check is scoped to compiler/; the registry floor
+    # still applies tree-wide
+    rep = run(tmp_path, {
+        'engine/e.py': """\
+        def f(devtel):
+            with devtel.stage('warp'):
+                pass
+        """,
+        'u.py': _stage_registry_uses(),
+    }, rules=['KTPU507'])
+    assert not rep.active
+
+
+def test_ktpu507_chunk_pipeline_stage_list(tmp_path):
+    rep = run(tmp_path, {
+        'compiler/c.py': """\
+        def build(fn):
+            return ChunkPipeline([('warp', fn), ('encode', fn)])
+        """,
+        'u.py': _stage_registry_uses(),
+    }, rules=['KTPU507'])
+    assert rule_ids(rep) == {'KTPU507'}
+    assert any("'warp'" in f.message for f in rep.active)
+
+
+def test_ktpu507_dead_stage_entries(tmp_path):
+    # a tree with no stage sites at all: every registry entry is dead
+    rep = run(tmp_path, {'a.py': 'X = 1\n'}, rules=['KTPU507'])
+    assert rule_ids(rep) == {'KTPU507'}
+    from kyverno_tpu.analysis.catalog_pass import load_stage_registry
+    assert len(rep.active) == len(load_stage_registry())
+
+
 # -- KTPU00x: suppression hygiene (meta rules) -------------------------------
 
 def test_ktpu001_positive_negative(tmp_path):
@@ -788,7 +850,7 @@ def test_rule_registry_complete():
                 'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
                 'KTPU401', 'KTPU402',
                 'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505',
-                'KTPU506'}
+                'KTPU506', 'KTPU507'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
