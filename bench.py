@@ -174,6 +174,17 @@ HET_OCCUPANCY_FLOOR = 2.0
 #: closed round trip visible on /debug/breakers and the state gauge.
 CHAOS_MAX_NON_200 = 0
 
+#: policy-churn ratchet for ``bench.py --policy-churn``: a mid-traffic
+#: edit of ONE policy in the replicated enforce set may compile at most
+#: this many NEW executables — the touched partition's admission shape
+#: (warm-up + live traffic share one canonical small-batch capacity).
+#: The partition-level assertion is exact (the recompiled pids must
+#: equal the churn differ's touched set); this count is the belt over
+#: the compile-cache census — a whole-world recompile storm (the
+#: pre-partition behavior: every executable of a 1k-policy set reminted
+#: for a one-line edit) fails the bench even if the differ lies.
+CHURN_RECOMPILED_EXECUTABLES_MAX = 2
+
 #: admission-latency SLO ratchet for the full bench: p99 of the
 #: /validate samples through the device-served chain at ~1k policies
 #: must stay under this ceiling.  Seeded at ~2x the BENCH_r06
@@ -1871,6 +1882,253 @@ def _chaos_breaker_drill(server, handlers, cluster, oracle, base,
 
 
 # --------------------------------------------------------------------------
+# Policy-churn serving bench: the partitioned-compilation claim
+# (kyverno_tpu/partition/).  A mid-traffic edit of ONE policy in the
+# replicated enforce set must (a) enforce the new text immediately (the
+# host loop serves the updated set while the touched partition
+# recompiles in the background), (b) recompile ONLY the touched
+# partition — every other partition's evaluator is reused verbatim and
+# the hot-swap carries breaker state — and (c) never surface as a
+# non-200 or a shed(breaker_open), with post-churn verdicts
+# bit-identical to a monolithic (KTPU_PARTITIONS=0) oracle rebuilt over
+# the same policy set.
+
+
+def admission_policy_churn(ctx, pods, threads: int = 4,
+                           requests_per_thread: int = 24) -> dict:
+    import copy as _copy
+    import dataclasses
+    import threading as _threading
+    from kyverno_tpu.api.policy import Policy as _Policy
+    from kyverno_tpu.conformance.loadgen import (SyntheticCluster,
+                                                 apply_churn)
+    from kyverno_tpu.observability.metrics import global_registry
+    from kyverno_tpu.partition.plan import diff_plans
+    from kyverno_tpu.policycache import cache as pcache
+
+    server, handlers, _n_replicated, device_served = ctx
+    reg = global_registry()
+    result: dict = {'device_served': device_served,
+                    'n_partitions_env': int(os.environ.get(
+                        'KTPU_PARTITIONS', '0') or 0),
+                    'ratchet_checked': bool(device_served)}
+    if not device_served:
+        # without a compiled scanner there is nothing to hot-swap;
+        # report, don't pretend
+        return result
+
+    ns0 = pods[0]['metadata'].get('namespace', '')
+
+    def enforce_policies():
+        return handlers.cache.get_policies(pcache.VALIDATE_ENFORCE,
+                                           'Pod', ns0)
+
+    live = enforce_policies()
+    old_scanner = handlers._device_scanner(live)
+    if old_scanner is None or getattr(old_scanner, '_pset', None) is None:
+        result['error'] = 'partitioned scanner not serving ' \
+            '(KTPU_PARTITIONS unset or fallback tripped)'
+        return result
+    old_plan = old_scanner._pset.plan
+
+    # probe: a pod that violates at least one live policy — the edit
+    # targets that policy, so its marker is observable in denials
+    probe_doc, target_idx = None, None
+    for doc in pods[:16]:
+        body = server.handle('/validate/fail',
+                             _admission_review(doc, 'churn-probe'))
+        resp = json.loads(body).get('response') or {}
+        if resp.get('allowed') is False:
+            msg = ((resp.get('status') or {}).get('message')) or ''
+            hits = [i for i, p in enumerate(live)
+                    if p.name and p.name in msg]
+            if hits:
+                # longest matching name wins: replicated names share
+                # prefixes (-r1 is a substring of -r10)
+                probe_doc = doc
+                target_idx = max(hits, key=lambda i: len(live[i].name))
+                break
+    if probe_doc is None:
+        raise AssertionError('policy churn: no probe pod is denied — '
+                             'enforcement is unobservable')
+
+    cluster = SyntheticCluster(seed=2026)
+    total = threads * requests_per_thread
+    event = cluster.churn_schedule(total, len(live))[0]
+    # retarget the scheduled edit onto the violated policy: same tick,
+    # same marker — the bench needs a target it can SEE enforced
+    event = dataclasses.replace(event, policy_index=target_idx)
+    result['churn_event'] = event.to_dict()
+    new_raws = apply_churn([_copy.deepcopy(p.raw) for p in live], event)
+
+    prior_mode = handlers.serving_mode
+    handlers.serving_mode = 'batch'
+    batcher = handlers._get_batcher()
+    shed_before = dict(batcher.stats()['shed'])
+    C = 'kyverno_tpu_compile_cache_requests_total'
+
+    def counter(name, **labels):
+        return reg.counter_value(name, **labels) if reg is not None \
+            else 0.0
+
+    miss0 = counter(C, result='miss')
+    load0 = counter(C, result='aot_load')
+    swaps0 = counter('kyverno_tpu_scanner_hot_swaps_total',
+                     kind='validate')
+    non200 = 0
+    t_edit = t_enforce = None
+
+    def send_raw(body_bytes):
+        nonlocal non200
+        body, status = server.handle_request('/validate/fail',
+                                             body_bytes)
+        if status != 200:
+            non200 += 1
+        return body
+
+    try:
+        # steady stream with the scheduled mid-burst edit: enforcement
+        # flips the instant the cache re-warms (host loop serves the
+        # new set while the touched partition recompiles behind it)
+        for i in range(total):
+            if i == event.tick:
+                t_edit = time.time()
+                handlers.cache.warm_up([_Policy(d) for d in new_raws])
+            if t_edit is not None and t_enforce is None and i % 2:
+                body = send_raw(_admission_review(probe_doc,
+                                                  f'churn-p{i}'))
+                if event.marker() in body.decode('utf-8', 'replace'):
+                    t_enforce = time.time()
+            else:
+                send_raw(cluster.review_bytes(i))
+        deadline = time.time() + 30.0
+        while t_enforce is None and time.time() < deadline:
+            body = send_raw(_admission_review(probe_doc, 'churn-late'))
+            if event.marker() in body.decode('utf-8', 'replace'):
+                t_enforce = time.time()
+        if t_enforce is None:
+            raise AssertionError('policy churn: edit never enforced '
+                                 '(marker absent from denials)')
+        # background hot-swap: the touched partition's recompile lands
+        new_live = enforce_policies()
+        swapped = handlers.wait_device_ready(new_live, timeout=float(
+            os.environ.get('BENCH_ADMISSION_WAIT_S', '90')))
+        t_swap = time.time()
+        # concurrent wave on the swapped-in scanner: churn must not
+        # surface as errors or breaker sheds under parallel load
+        barrier = _threading.Barrier(threads + 1)
+
+        def work(tid):
+            barrier.wait()
+            for j in range(requests_per_thread):
+                send_raw(cluster.review_bytes(
+                    total + tid + j * threads))
+
+        workers = [_threading.Thread(target=work, args=(tid,))
+                   for tid in range(threads)]
+        for t in workers:
+            t.start()
+        barrier.wait()
+        for t in workers:
+            t.join()
+    finally:
+        handlers.serving_mode = prior_mode
+
+    shed_after = dict(batcher.stats()['shed'])
+    breaker_shed = shed_after.get('breaker_open', 0) - \
+        shed_before.get('breaker_open', 0)
+    fresh_executables = int(counter(C, result='miss') - miss0)
+    new_scanner = handlers._device_scanner(new_live)
+    if not swapped or new_scanner is None or \
+            getattr(new_scanner, '_pset', None) is None:
+        raise AssertionError('policy churn: hot-swap did not land a '
+                             'partitioned scanner')
+    diff = diff_plans(old_plan, new_scanner._pset.plan)
+    recompiled = sorted(new_scanner._pset.recompiled())
+    result.update({
+        'requests': 2 * total, 'non_200': non200,
+        'shed_breaker_open': breaker_shed,
+        'enforcement_ms': round((t_enforce - t_edit) * 1000, 1),
+        'device_swap_s': round(t_swap - t_edit, 2),
+        'touched_partitions': sorted(diff.touched),
+        'unchanged_partitions': len(diff.unchanged),
+        'recompiled_partitions': recompiled,
+        'fresh_executables': fresh_executables,
+        'aot_loaded_executables': int(counter(C, result='aot_load')
+                                      - load0),
+        'ratchet_max_fresh_executables':
+            CHURN_RECOMPILED_EXECUTABLES_MAX,
+        'hot_swaps': int(counter('kyverno_tpu_scanner_hot_swaps_total',
+                                 kind='validate') - swaps0),
+    })
+    if non200 > CHAOS_MAX_NON_200:
+        raise AssertionError(
+            f'policy churn: {non200} non-200 responses — churn must '
+            f'never surface as an error')
+    if breaker_shed:
+        raise AssertionError(
+            f'policy churn: {breaker_shed} requests shed breaker_open '
+            f'— the hot-swap must never put churn on the shed path')
+    if len(diff.touched) != 1:
+        raise AssertionError(
+            f'policy churn: one-policy edit touched partitions '
+            f'{sorted(diff.touched)} — expected exactly one')
+    if recompiled != sorted(diff.touched):
+        raise AssertionError(
+            f'policy churn: recompiled partitions {recompiled} != '
+            f'differ touched set {sorted(diff.touched)} — untouched '
+            f'evaluators must be reused verbatim')
+    if fresh_executables > CHURN_RECOMPILED_EXECUTABLES_MAX:
+        raise AssertionError(
+            f'policy churn: {fresh_executables} fresh executables '
+            f'(> committed max {CHURN_RECOMPILED_EXECUTABLES_MAX}) — '
+            f'the one-partition recompile is not holding')
+    _progress(f'policy churn: enforcement '
+              f"{result['enforcement_ms']}ms, swap "
+              f"{result['device_swap_s']}s, recompiled {recompiled} "
+              f'of {len(new_scanner._pset.runtimes)} partitions')
+
+    # monolithic oracle over the SAME post-churn set: partitioned
+    # serving must be bit-identical, churn or not
+    sample = [cluster.review_bytes(10000 + k) for k in range(48)]
+    sample.append(_admission_review(probe_doc, 'oracle-probe'))
+    part_resp = [json.loads(server.handle('/validate/fail', b)
+                            ).get('response') for b in sample]
+    saved_parts = os.environ.get('KTPU_PARTITIONS')
+    os.environ['KTPU_PARTITIONS'] = '0'
+    try:
+        from kyverno_tpu.policycache.cache import Cache as _Cache
+        from kyverno_tpu.webhooks.handlers import \
+            ResourceHandlers as _Handlers
+        from kyverno_tpu.webhooks.server import WebhookServer as _Server
+        ocache = _Cache()
+        ocache.warm_up([_Policy(_copy.deepcopy(d)) for d in new_raws])
+        ohandlers = _Handlers(ocache)
+        oserver = _Server(ohandlers)
+        oracle_served = ohandlers.wait_device_ready(
+            ocache.get_policies(pcache.VALIDATE_ENFORCE, 'Pod', ns0),
+            timeout=float(os.environ.get('BENCH_ADMISSION_WAIT_S',
+                                         '90')))
+        mismatches = sum(
+            1 for b, want in zip(sample, part_resp)
+            if json.loads(oserver.handle('/validate/fail', b)
+                          ).get('response') != want)
+        ohandlers.shutdown()
+    finally:
+        if saved_parts is None:
+            os.environ.pop('KTPU_PARTITIONS', None)
+        else:
+            os.environ['KTPU_PARTITIONS'] = saved_parts
+    result['oracle_device_served'] = oracle_served
+    result['oracle_mismatches'] = mismatches
+    if mismatches:
+        raise AssertionError(
+            f'policy churn: {mismatches} verdicts diverged from the '
+            f'monolithic (KTPU_PARTITIONS=0) oracle')
+    return result
+
+
+# --------------------------------------------------------------------------
 # Rescan churn bench: the O(churn) claim for the digest-keyed verdict
 # cache (kyverno_tpu/verdictcache/).  Steady state: every tick demands a
 # full report rebuild over N rows of which only churn_ratio changed —
@@ -2250,6 +2508,30 @@ def admission_concurrency_main(platform: str) -> int:
     return 0
 
 
+def policy_churn_main(platform: str) -> int:
+    """``bench.py --policy-churn``: mid-traffic one-policy edit against
+    the partitioned serving chain — survive policy churn without
+    recompiling the world (CI-sized; scale the policy set with
+    BENCH_CHURN_POLICIES, the plan with KTPU_PARTITIONS)."""
+    import random
+    os.environ.setdefault('KTPU_PARTITIONS', '8')
+    policies = load_policy_pack()
+    rng = random.Random(42)
+    pods = [make_pod(rng, i) for i in range(256)]
+    target = int(os.environ.get('BENCH_CHURN_POLICIES', '200'))
+    _progress(f'policy-churn serving chain @{target} policies, '
+              f"KTPU_PARTITIONS={os.environ['KTPU_PARTITIONS']}")
+    ctx = _admission_server(policies, pods, target_policies=target)
+    block = admission_policy_churn(ctx, pods)
+    ctx[1].shutdown()
+    print(json.dumps({
+        'metric': 'policy_churn', 'platform': platform,
+        'n_policies': ctx[2], 'device_served': ctx[3],
+        'policy_churn': block,
+    }))
+    return 0
+
+
 def admission_chaos_main(platform: str) -> int:
     """``bench.py --admission-chaos``: run only the chaos block —
     synthetic-cluster waves under injected faults plus the breaker
@@ -2328,6 +2610,16 @@ def main() -> int:
             traceback.print_exc()
             print(json.dumps({
                 'metric': 'admission_chaos', 'platform': platform,
+                'error': f'{type(e).__name__}: {e}'}))
+            return 1
+    if '--policy-churn' in sys.argv[1:]:
+        try:
+            return policy_churn_main(platform)
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                'metric': 'policy_churn', 'platform': platform,
                 'error': f'{type(e).__name__}: {e}'}))
             return 1
     if '--warm-probe' in sys.argv[1:]:

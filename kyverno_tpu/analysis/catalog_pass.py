@@ -36,6 +36,13 @@ module API, and exit codes are unchanged).
   so an unregistered label silently drops out of attribution), or a
   registered stage with no use site anywhere in the tree (dead-stage
   check, the KTPU503/505 analogue).
+* **KTPU508** — partition key hygiene: an ``executable_cache_key``
+  call site outside ``kyverno_tpu/partition/`` whose fingerprint
+  operand (resolved one level through enclosing-scope bindings, the
+  KTPU204 depth) consumes ``policy_set_fingerprint`` — the whole-set
+  fingerprint in a compile/AOT key means one policy edit invalidates
+  every partition's executables; draw it from
+  ``partition/keys.compile_fingerprint`` instead.
 * **KTPU506** — unit mismatch at a write site: a cataloged metric whose
   name declares its unit (``*_seconds[_total]`` / ``*_bytes[_total]``)
   is fed a value that carries the wrong one — a ``*_ms`` name with no
@@ -599,6 +606,87 @@ def _check_unit_mismatch(ctx: Context) -> Iterable[Finding]:
                             f'is len() of a str — that counts '
                             f'characters, not bytes; len(s.encode()) '
                             f'measures the wire size')
+
+
+# -- partition key-hygiene pass (KTPU508) -------------------------------------
+
+def _fingerprint_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The fingerprint operand of an ``executable_cache_key`` call
+    (first positional, or the ``fingerprint=`` keyword)."""
+    for kw in call.keywords:
+        if kw.arg == 'fingerprint':
+            return kw.value
+    if call.args:
+        return call.args[0]
+    return None
+
+
+def _contains_set_fingerprint(expr: ast.AST) -> bool:
+    from .retrace import _callee_name
+    return any(isinstance(n, ast.Call) and
+               _callee_name(n.func) == 'policy_set_fingerprint'
+               for n in ast.walk(expr))
+
+
+@register('KTPU508', 'compile/AOT key construction outside partition/ '
+                     'consumes the whole-set fingerprint '
+                     '(policy_set_fingerprint) — one policy edit would '
+                     'invalidate every partition\'s executables')
+def _check_partition_key_hygiene(ctx: Context) -> Iterable[Finding]:
+    """``executable_cache_key`` callers must take their fingerprint
+    from ``partition/keys.compile_fingerprint`` (which scopes it to the
+    policies actually compiled into the evaluator), never directly from
+    ``policy_set_fingerprint`` over the whole set — that spelling works
+    until the first partitioned build, then silently degrades every
+    policy edit back to a recompile-the-world.  ``partition/`` itself
+    is the sanctioned authority and is exempt.  The fingerprint operand
+    resolves one level through enclosing-scope bindings (KTPU204
+    depth), innermost scope first — the binding feeding a nested
+    closure's name may live in the enclosing builder function
+    (``ops/eval.py:build_evaluator``)."""
+    from .retrace import _callee_name, _scope_bindings
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        rel = '/' + sf.rel.replace(os.sep, '/')
+        if '/partition/' in rel:
+            continue
+        sites: List[Tuple[List[ast.AST], ast.Call]] = []
+
+        def visit(node: ast.AST, chain: List[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                inner = chain
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = chain + [child]
+                if isinstance(child, ast.Call) and \
+                        _callee_name(child.func) == \
+                        'executable_cache_key':
+                    sites.append((chain, child))
+                visit(child, inner)
+
+        visit(sf.tree, [sf.tree])
+        for chain, call in sites:
+            expr = _fingerprint_arg(call)
+            if expr is None:
+                continue
+            if isinstance(expr, ast.Name):
+                resolved = None
+                for scope in reversed(chain):
+                    resolved = _scope_bindings(scope).get(expr.id)
+                    if resolved is not None:
+                        break
+                if resolved is None:
+                    continue  # parameter / out-of-scope: undecidable
+                expr = resolved
+            if _contains_set_fingerprint(expr):
+                yield sf.finding(
+                    'KTPU508', call,
+                    'executable cache key consumes the whole-set '
+                    'fingerprint (policy_set_fingerprint) outside '
+                    'partition/ — draw it from '
+                    'partition/keys.compile_fingerprint so partitioned '
+                    'builds key executables per partition')
 
 
 def render_span_table() -> str:

@@ -256,6 +256,45 @@ class BatchScanner:
         self._adm = getattr(self._evaluator, 'adm_table', None)
         self._adm_cols = self._evaluator.adm_cols \
             if self._adm is not None else None
+        # partitioned compile (KTPU_PARTITIONS > 0, non-mesh): one
+        # evaluator per policy-group partition, AOT-keyed by the
+        # partition fingerprint (kyverno_tpu/partition/), per-partition
+        # outputs merged back into the whole-set verdict contract by the
+        # composer.  Any structural mismatch falls back to the
+        # monolithic evaluator above — never a wrong verdict.  The
+        # whole-set evaluator stays as assembly metadata (any_meta,
+        # n_cols, dev masks); jax.jit is lazy, so it never compiles
+        # unless the fallback actually dispatches it.
+        self._pset = None
+        self._composer = None
+        from ..partition.plan import PartitionError, env_partitions
+        _n_parts = env_partitions()
+        if _n_parts > 0 and mesh is None and self.cps.programs:
+            try:
+                from ..partition import census as _census
+                from ..partition.compose import Composer
+                from ..partition.runtime import build_runtime
+                _pset = build_runtime(policies, self.cps, _n_parts,
+                                      set_fingerprint=self.fingerprint)
+                self._composer = Composer(self._evaluator,
+                                          _pset.runtimes)
+                self._pset = _pset
+            except PartitionError:
+                from ..observability.metrics import global_registry
+                from ..partition.runtime import PARTITION_FALLBACKS
+                _reg = global_registry()
+                if _reg is not None:
+                    _reg.inc(PARTITION_FALLBACKS)
+            else:
+                # partitioned dispatches ship no whole-set in-graph
+                # admission lanes: with self._adm None no
+                # AdmissionRowPlan is ever built and the host matcher
+                # decides admission rows exactly — plan=None semantics,
+                # bit-identical to the monolithic oracle
+                self._adm = None
+                self._adm_cols = None
+                _census.record_plan(self.fingerprint, _pset.plan,
+                                    serial=self.serial)
         from collections import OrderedDict
         self._simple_match = [
             _rule_match_is_simple(p.rule_raw or {}) for p in self.cps.programs]
@@ -328,7 +367,34 @@ class BatchScanner:
         table = sorted(set(caps if caps is not None else canonical_caps(
             chunk=self.CHUNK, small=self.SMALL_BATCH)))
 
+        def warm_partitions(cap: int) -> float:
+            # partitioned mode warms each partition's evaluator with
+            # the exact tensor signature the partitioned scan path
+            # produces (per-partition lanes + __rowvalid__ + the
+            # partition-local unique-space __match__ plane + the
+            # partition's admission lanes when it has any)
+            t0 = time.monotonic()
+            device = self._small_device() \
+                if self.mesh is None and cap <= self.SMALL_BATCH else None
+            for rt in self._pset.runtimes:
+                batch = encode_batch([copy.deepcopy(WARM_POD)],
+                                     rt.sub_cps, padded_n=cap)
+                tensors = batch.tensors()
+                tensors['__match__'] = np.zeros(
+                    (cap, rt.evaluator.n_uniq), np.uint8)
+                if rt.adm is not None:
+                    tensors.update(admission_lanes.zero_lanes(
+                        rt.adm, cap))
+                t, layout = shard_batch(tensors, None, device=device)
+                out = rt.evaluator(t, layout)
+                for arr in out:
+                    np.asarray(arr)
+                self._free_inputs(t, out)
+            return time.monotonic() - t0
+
         def warm_one(cap: int) -> float:
+            if self._composer is not None:
+                return warm_partitions(cap)
             t0 = time.monotonic()
             batch = encode_batch([copy.deepcopy(WARM_POD)], self.cps,
                                  padded_n=cap)
@@ -609,6 +675,10 @@ class BatchScanner:
                 else np.zeros((n, len(self.cps.programs)), bool)
             yield 0, z, z, z.astype(np.int32), None, zm
             return
+        if self._composer is not None:
+            yield from self._partitioned_status_chunks(
+                resources, contexts, match, match_fn, timeline)
+            return
         from ..observability import device as devtel
         from ..observability import timeline as tlmod
         from ..observability import tracing
@@ -835,6 +905,154 @@ class BatchScanner:
              ('device_eval', stage_eval), ('d2h', stage_d2h)],
             capture=tel_capture, parent_span=tel_parent,
             cleanup=release_chunk, timeline=timeline)
+        yield from pipe.run(range(0, n, chunk))
+
+    def _partitioned_status_chunks(self, resources: List[dict],
+                                   contexts: Optional[List[dict]] = None,
+                                   match: Optional[np.ndarray] = None,
+                                   match_fn=None, timeline=None):
+        """Partitioned twin of ``_device_status_chunks``: each chunk
+        encodes and dispatches once per partition runtime (the
+        partition's own slot vocabulary, match plane and executable),
+        then the composer scatters the per-partition buffers back into
+        whole-set ``(status, detail, fdet)`` — the yield contract is
+        identical, so assembly downstream never knows partitions exist.
+
+        Differences from the monolithic path, all deliberate:
+
+        * no forked encode pool and no :class:`LaneArena` — both are
+          bound to the whole-set ``cps`` vocabulary, and per-partition
+          lane sets are smaller (the arena would fragment across
+          heterogeneous vocabularies);
+        * no in-graph admission output — ``self._adm`` is None in
+          partitioned mode, so admission rows were already decided
+          exactly by the host matcher (the yielded ``adm`` is None);
+        * per-partition evaluators dispatch serially within a chunk
+          (one accelerator; the chunk pipeline still overlaps encode /
+          h2d / eval / d2h across chunks)."""
+        n = len(resources)
+        from ..observability import device as devtel
+        from ..observability import timeline as tlmod
+        from ..observability import tracing
+        from ..ops.eval import (expand_compact, fold_match_unique,
+                                shard_batch)
+        from .pipeline import ChunkPipeline
+        chunk = self.CHUNK
+        small = self.mesh is None and n <= self.SMALL_BATCH
+        device = self._small_device() if small else None
+        tel_parent = tracing.current_span()
+        tel_capture = devtel.current_capture()
+        rts = self._pset.runtimes
+
+        def stage_encode(start):
+            faults.check(faults.SITE_ENCODE)
+            part = resources[start:start + chunk]
+            part_ctx = contexts[start:start + chunk] \
+                if contexts is not None else None
+            cm = match[start:start + len(part)] if match is not None \
+                else (match_fn(start, part) if match_fn is not None
+                      else None)
+            bucket = chunk if n > chunk else canonical_capacity(
+                len(part), chunk=chunk, small=self.SMALL_BATCH)
+            encs = []
+            with devtel.stage('encode', {'rows': len(part),
+                                         'partitions': len(rts)}):
+                for rt in rts:
+                    batch = encode_batch(part, rt.sub_cps,
+                                         padded_n=bucket,
+                                         contexts=part_ctx)
+                    encs.append(batch.tensors())
+            return {'start': start, 'ln': len(part), 'bucket': bucket,
+                    'encs': encs, 'cm': cm}
+
+        def stage_h2d(p):
+            faults.check(faults.SITE_H2D)
+            ln = p['ln']
+            devtel.set_batch_size(ln)
+            cm = p['cm']
+            dev_m = (cm & self._dev_mask).astype(np.uint8) \
+                if cm is not None else None
+            shipped = []
+            for rt, tensors in zip(rts, p['encs']):
+                padded = next(iter(tensors.values())).shape[0]
+                tensors = dict(tensors)
+                if dev_m is not None:
+                    # slice the global device-mask'd match down to this
+                    # partition's program columns, then fold to ITS
+                    # unique space — each executable sees exactly the
+                    # plane the monolithic path would have shown for
+                    # those columns
+                    mm_u = fold_match_unique(
+                        np.ascontiguousarray(dev_m[:, rt.prog_cols]),
+                        rt.evaluator)
+                    mm = np.zeros((padded, mm_u.shape[1]), np.uint8)
+                    mm[:ln] = mm_u
+                    tensors['__match__'] = mm
+                if rt.adm is not None:
+                    # zero lanes keep the executable signature stable
+                    # (the in-graph decision is discarded; the host
+                    # matcher already decided admission rows)
+                    tensors.update(admission_lanes.zero_lanes(
+                        rt.adm, padded))
+                shipped.append(shard_batch(tensors, None,
+                                           device=device))
+            p['encs'] = None
+            p['shipped'] = shipped
+            return p
+
+        def stage_eval(p):
+            faults.check(faults.SITE_DEVICE_EVAL)
+            p['outs'] = [rt.evaluator(t, layout)
+                         for rt, (t, layout) in zip(rts, p['shipped'])]
+            return p
+
+        def stage_d2h(p):
+            faults.check(faults.SITE_D2H)
+            start, ln = p['start'], p['ln']
+            parts_out = []
+            with devtel.d2h_guard({'chunk_start': start,
+                                   'rows': ln}) as g:
+                for rt, (t, _layout), out in zip(rts, p['shipped'],
+                                                 p['outs']):
+                    if len(out) == 2:
+                        o8 = np.array(out[0])
+                        o32 = np.array(out[1])
+                        g.add_d2h_bytes(o8.nbytes + o32.nbytes)
+                        s_k, d_k, fd_k, _adm = expand_compact(
+                            o8, o32, rt.evaluator)
+                    else:
+                        s_k, d_k, fd_k = (np.array(out[0]),
+                                          np.array(out[1]),
+                                          np.array(out[2]))
+                        g.add_d2h_bytes(s_k.nbytes + d_k.nbytes +
+                                        fd_k.nbytes)
+                    self._free_inputs(t, out)
+                    parts_out.append((s_k[:ln], d_k[:ln], fd_k[:ln]))
+            p['shipped'] = p['outs'] = None
+            s, d, fd = self._composer.compose(parts_out, ln)
+            return start, s, d, fd, None, p['cm']
+
+        if n <= chunk:
+            with devtel.install_capture(tel_capture), \
+                    tracing.tracer().start_span(
+                        'kyverno/device/chunk', {'chunk_start': 0},
+                        parent=tel_parent):
+                with tlmod.exec_scope(timeline, 0, 'encode'):
+                    p = stage_encode(0)
+                with tlmod.exec_scope(timeline, 0, 'h2d'):
+                    p = stage_h2d(p)
+                with tlmod.exec_scope(timeline, 0, 'device_eval'):
+                    p = stage_eval(p)
+                with tlmod.exec_scope(timeline, 0, 'd2h'):
+                    result = stage_d2h(p)
+            yield result
+            return
+
+        pipe = ChunkPipeline(
+            [('encode', stage_encode), ('h2d', stage_h2d),
+             ('device_eval', stage_eval), ('d2h', stage_d2h)],
+            capture=tel_capture, parent_span=tel_parent,
+            timeline=timeline)
         yield from pipe.run(range(0, n, chunk))
 
     def _device_statuses(self, resources: List[dict],

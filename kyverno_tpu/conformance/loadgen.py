@@ -21,7 +21,11 @@ Consumers:
   *poison* — their ``chaos`` label is what a marker-armed
   ``KTPU_FAULTS`` clause keys on — and pair the traffic with a fault
   schedule, so a run under injected failures replays against its own
-  fault-free oracle.
+  fault-free oracle;
+* the policy-churn bench (``bench.py --policy-churn``) and churn
+  tests share :meth:`SyntheticCluster.churn_schedule` /
+  :func:`apply_churn` — deterministic mid-burst policy edit/add/delete
+  events at fixed request ticks.
 
 Layered beside the kuttl/scenario harness (this package): scenarios
 replay *recorded* cases, the generator synthesizes *load*.
@@ -30,7 +34,9 @@ replay *recorded* cases, the generator synthesizes *load*.
 from __future__ import annotations
 
 import bisect
+import copy
 import json
+from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -38,6 +44,63 @@ from typing import Dict, Iterator, List, Optional, Tuple
 #: the key the fault injector's ``marker=`` clauses match on
 #: (``kyverno_tpu.faults.MARKER_LABEL``); inert in a fault-free run
 POISON_MARKER = 'poison'
+
+
+@dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled policy change mid-traffic: at request ``tick``,
+    apply ``action`` (edit | add | delete) to ``policy_index`` of the
+    live policy set.  ``seed`` disambiguates the edit content so two
+    events against the same policy produce distinct fingerprints."""
+    tick: int
+    action: str
+    policy_index: int
+    seed: int
+
+    def marker(self) -> str:
+        """The string the event's edit stamps into the policy — what a
+        bench polls for in responses to observe enforcement."""
+        return f'[churn-{self.seed}]'
+
+    def to_dict(self) -> Dict:
+        return {'tick': self.tick, 'action': self.action,
+                'policy_index': self.policy_index, 'seed': self.seed,
+                'marker': self.marker()}
+
+
+def apply_churn(raw_policies: List[Dict], event: ChurnEvent
+                ) -> List[Dict]:
+    """Apply one :class:`ChurnEvent` to a list of raw policy documents,
+    returning a NEW list with deep-copied changed entries (the inputs
+    are never mutated — callers keep the pre-churn set as the oracle).
+
+    * ``edit`` appends the event marker to the target's first validate
+      message: a semantic change (new compile fingerprint, new verdict
+      text) that leaves the policy's slot vocabulary — and therefore
+      its partition assignment — intact.
+    * ``add`` clones the target under a ``-churn<seed>`` name.
+    * ``delete`` removes the target.
+    """
+    idx = event.policy_index % max(1, len(raw_policies))
+    out = list(raw_policies)
+    if event.action == 'delete':
+        del out[idx]
+        return out
+    doc = copy.deepcopy(raw_policies[idx])
+    rules = ((doc.get('spec') or {}).get('rules')) or []
+    for rule in rules:
+        validate = rule.get('validate')
+        if isinstance(validate, dict) and 'message' in validate:
+            validate['message'] = \
+                f"{validate['message']} {event.marker()}"
+            break
+    if event.action == 'add':
+        meta = doc.setdefault('metadata', {})
+        meta['name'] = f"{meta.get('name', 'pol')}-churn{event.seed}"
+        out.append(doc)
+    else:  # edit
+        out[idx] = doc
+    return out
 
 
 def _zipf_cum(n: int, s: float) -> List[float]:
@@ -220,6 +283,35 @@ class SyntheticCluster:
             else:  # steady
                 delay = 1.0 / rate_per_s
             yield delay, self.review_bytes(i)
+
+    # -- mid-burst policy churn --------------------------------------------
+
+    def churn_schedule(self, count: int, n_policies: int,
+                       events: int = 1, start_frac: float = 0.25,
+                       end_frac: float = 0.75,
+                       actions: Tuple[str, ...] = ('edit',)
+                       ) -> List['ChurnEvent']:
+        """Deterministic mid-burst policy-churn schedule: ``events``
+        policy changes at fixed request ticks, evenly spread across
+        ``[start_frac, end_frac)`` of a ``count``-request run.  Pure in
+        ``(seed, count, n_policies, events, ...)`` so the churn bench
+        and the chaos drills fire the exact same edits at the exact
+        same ticks — a churn run replays against its own oracle.
+        Actions cycle through ``actions``; the targeted policy index is
+        a seed-keyed draw so different seeds churn different policies.
+        """
+        events = max(1, events)
+        span = max(0.0, end_frac - start_frac)
+        out: List[ChurnEvent] = []
+        for k in range(events):
+            tick = int(count * (start_frac + span * k / events))
+            rng = self._rng(-1000 - k)
+            out.append(ChurnEvent(
+                tick=min(max(tick, 0), max(count - 1, 0)),
+                action=actions[k % len(actions)],
+                policy_index=rng.randrange(max(1, n_policies)),
+                seed=(self.seed << 8) ^ k))
+        return out
 
     # -- exception-holding tenants ----------------------------------------
 

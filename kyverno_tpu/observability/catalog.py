@@ -114,12 +114,42 @@ METRICS: Dict[str, Metric] = {
         'counter', 'Verdict rows dropped by the memory-LRU entry cap '
         'or generation snapshots dropped by the disk byte budget '
         '(KTPU_VERDICT_CACHE_MAX).'),
+    'kyverno_tpu_verdict_cache_partial_hits_total': Metric(
+        'counter', 'Partitioned-cache lookups that missed the full row '
+        'but held every unchanged partition\'s subrow — the row '
+        're-scanned against only the touched partitions\' policies '
+        '(verdictcache/partitioned.py).'),
     'kyverno_tpu_rescan_rows_scanned': Metric(
         'gauge', 'Rows the most recent background reconcile evaluated '
         'on the dense device path.'),
     'kyverno_tpu_rescan_rows_replayed': Metric(
         'gauge', 'Rows the most recent background reconcile replayed '
         'from the verdict cache.'),
+    # partitioned policy-set compilation (kyverno_tpu/partition/)
+    'kyverno_tpu_partition_count': Metric(
+        'gauge', 'Device-evaluated partitions of the most recently '
+        'built partitioned scanner (KTPU_PARTITIONS).'),
+    'kyverno_tpu_partition_recompiles_total': Metric(
+        'counter', 'Partition evaluators built fresh (no evaluator-'
+        'cache entry for the partition fingerprint) — under policy '
+        'churn this should track touched partitions, not the set.'),
+    'kyverno_tpu_partition_evaluator_reuses_total': Metric(
+        'counter', 'Partition evaluators served from the process-wide '
+        'evaluator cache (fingerprint unchanged across a scanner '
+        'rebuild).'),
+    'kyverno_tpu_partition_fallbacks_total': Metric(
+        'counter', 'Scanner builds that requested partitioning but '
+        'fell back to the monolithic whole-set compile '
+        '(PartitionError: unsupported layout for composition).'),
+    # scanner hot-swap under live traffic (webhooks/handlers.py)
+    'kyverno_tpu_scanner_hot_swaps_total': Metric(
+        'counter', 'Live scanner replacements after policy churn: the '
+        'successor took over a same-kind predecessor\'s slot without '
+        'draining traffic, by kind=.'),
+    'kyverno_tpu_breaker_migrations_total': Metric(
+        'counter', 'Circuit-breaker entries carried from a retired '
+        'scanner\'s key to its hot-swap successor instead of being '
+        'reset to closed.'),
     # AOT cache + warm-up instruments (aotcache/)
     'kyverno_tpu_aot_warm_duration_seconds': Metric(
         'histogram', 'Background warm-up wall time by target/state '

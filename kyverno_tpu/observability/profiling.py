@@ -305,6 +305,15 @@ def _r_executables(query):
     return _json_body(led.report())
 
 
+@debug_route('/debug/partitions',
+             'Partitioned-compilation census: per-partition member/'
+             'fingerprint/executable attribution for each live plan '
+             'plus the recent hot-swap log, JSON.')
+def _r_partitions(query):
+    from ..partition import census
+    return _json_body(census.report())
+
+
 @debug_route('/debug/slo',
              'Serving SLO state: burn rates, budget remaining, '
              'per-path windowed latency digests, JSON.')

@@ -256,12 +256,18 @@ def analyze(events: Iterable[StageEvent], t0: float,
             for s, v in blame.items()}
     bound_by = max(blame, key=lambda s: blame[s]) if blame else ''
     suggest, note = advise(bound_by, frac.get(bound_by, 0.0))
+    blame_r = {s: round(v, 6) for s, v in blame.items()}
+    executing_r = {s: round(v, 6) for s, v in executing.items()}
+    # waiting derives from the rounded pair so the executing+waiting ==
+    # blame partition survives rounding exactly
+    waiting_r = {s: round(max(0.0, blame_r[s] - executing_r.get(s, 0.0)), 6)
+                 for s in blame_r}
     return {
         'wall_s': round(wall, 6),
-        'blame_s': {s: round(v, 6) for s, v in blame.items()},
+        'blame_s': blame_r,
         'blame_frac': {s: round(v, 4) for s, v in frac.items()},
-        'executing_s': {s: round(v, 6) for s, v in executing.items()},
-        'waiting_s': {s: round(v, 6) for s, v in waiting.items()},
+        'executing_s': executing_r,
+        'waiting_s': waiting_r,
         'bound_by': bound_by,
         'suggest': suggest,
         'note': note,

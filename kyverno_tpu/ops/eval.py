@@ -1817,7 +1817,13 @@ def build_evaluator(cps: CompiledPolicySet):
         return out8, out32
 
     jitted = jax.jit(evaluate_packed)
-    fingerprint = policy_set_fingerprint(cps.policies)
+    # compile/AOT keys derive from the fingerprint of the policies THIS
+    # evaluator compiles — the whole set in monolithic mode, one
+    # partition's members under KTPU_PARTITIONS (partition/keys.py is
+    # the sanctioned source; ktpu-lint KTPU508 keeps whole-set
+    # fingerprints out of executable cache keys elsewhere)
+    from ..partition.keys import compile_fingerprint
+    fingerprint = compile_fingerprint(cps)
     exec_cache: Dict[str, Any] = {}
     # id(compiled) -> ledger key: dispatch-site attribution for the
     # executable lifecycle ledger without re-deriving the cache key per

@@ -1,0 +1,42 @@
+"""Partition-scoped compile/AOT key derivation.
+
+The whole-set fingerprint (``aotcache/keys.py:policy_set_fingerprint``)
+is the right identity for *provenance* — "which policy set served this
+decision" — but the wrong identity for *executable cache keys*: one
+edited policy in a 1k-policy enforce set changes the whole-set
+fingerprint and invalidates every compiled executable (the 49–93s
+``cache_warm_s`` wall on every churn event).
+
+This module is the single sanctioned construction site for the
+fingerprint an executable cache key may consume.  An evaluator built
+over a partition's member policies gets a fingerprint derived from
+*those members only* — editing any other policy leaves it (and every
+AOT entry keyed under it) untouched.  ktpu-lint **KTPU508** enforces
+the boundary: ``executable_cache_key`` callers outside
+``kyverno_tpu/partition/`` must not feed it a whole-set
+``policy_set_fingerprint(...)`` result.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..aotcache.keys import policy_set_fingerprint
+
+
+def compile_fingerprint(cps) -> str:
+    """The fingerprint executable cache keys are derived from.
+
+    For a :class:`CompiledPolicySet` over a partition's member policies
+    this is the *partition* fingerprint — stable under edits to any
+    policy outside the partition.  For a whole-set compile (the
+    ``KTPU_PARTITIONS=0`` monolithic oracle) it degenerates to the
+    whole-set fingerprint, preserving every existing AOT key."""
+    return policy_set_fingerprint(cps.policies)
+
+
+def partition_fingerprint(policies: Iterable) -> str:
+    """Fingerprint of one partition's member policies, in membership
+    order.  Identical inputs across processes yield identical AOT keys,
+    so a second process warm-loads untouched partitions from disk."""
+    return policy_set_fingerprint(list(policies))

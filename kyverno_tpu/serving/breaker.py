@@ -261,6 +261,30 @@ class BreakerRegistry:
             self.on_open(opened)
         return state
 
+    def migrate(self, old_key, new_key, policies=None) -> str:
+        """Carry breaker state from a retired scanner key to its
+        successor (scanner hot-swap: same logical policy set, new
+        compiled serial).  Without this a swap silently forgives an
+        open breaker — the recompiled set would re-enter the device
+        path with a clean slate while the backend fault that tripped it
+        may still be live.  The entry moves verbatim (state, failure
+        count, trips, backoff clock); ``policies`` re-pins the entry on
+        the successor's policy objects so the id()-tuple key stays
+        collision-safe.  Returns the migrated state (:data:`CLOSED`
+        when there was nothing to carry)."""
+        with self._lock:
+            entry = self._entries.pop(old_key, None)
+            if entry is None:
+                return CLOSED
+            if policies is not None:
+                entry.policies = list(policies)
+            # an in-flight probe belonged to the retired scanner; the
+            # successor's first allow() re-probes on its own clock
+            entry.probe_inflight = False
+            self._entries[new_key] = entry
+            self._emit_states()
+            return entry.state
+
     def record_success(self, key) -> None:
         """One device success for ``key``: closes a half-open breaker
         (recovery — the set is re-admitted to the device path) and

@@ -18,6 +18,11 @@ churn (~1% of rows per tick), not cluster size.
   atomic digest-framed on-disk snapshots per cache generation (the
   ``aotcache/store.py`` protocol), uid-keyed invalidation, and the
   hit/miss/eviction + per-tick rescan telemetry.
+* :mod:`.partitioned` — per-partition generations over the
+  :mod:`kyverno_tpu.partition` plan (``KTPU_PARTITIONS>0``): a policy
+  edit rolls only the touched partitions' generations, unchanged
+  verdict subrows keep replaying, and partial hits re-scan rows
+  against only the touched partitions' policies.
 
 The dense full scan stays the cold path and the correctness oracle:
 ``KTPU_VERDICT_CACHE=off`` produces bit-identical reports (pinned by
@@ -31,6 +36,8 @@ feeding invalidation.
 
 from .keys import (VERDICT_VERSION, engine_rev, generation_key,
                    spec_digest)
+from .partitioned import (VERDICT_CACHE_PARTIAL_HITS,
+                          PartitionedVerdictCache)
 from .store import (RESCAN_ROWS_REPLAYED, RESCAN_ROWS_SCANNED,
                     VERDICT_CACHE_EVICTIONS, VERDICT_CACHE_HITS,
                     VERDICT_CACHE_MISSES, VerdictCache, publish_tick)
@@ -39,5 +46,6 @@ __all__ = [
     'VERDICT_VERSION', 'engine_rev', 'generation_key', 'spec_digest',
     'RESCAN_ROWS_REPLAYED', 'RESCAN_ROWS_SCANNED',
     'VERDICT_CACHE_EVICTIONS', 'VERDICT_CACHE_HITS',
-    'VERDICT_CACHE_MISSES', 'VerdictCache', 'publish_tick',
+    'VERDICT_CACHE_MISSES', 'VERDICT_CACHE_PARTIAL_HITS',
+    'PartitionedVerdictCache', 'VerdictCache', 'publish_tick',
 ]

@@ -173,6 +173,18 @@ class VerdictCache:
                 reg.inc(VERDICT_CACHE_HITS)
         return row
 
+    def peek(self, digest: str) -> Optional[dict]:
+        """``lookup`` without outcome accounting: composite caches
+        (``verdictcache/partitioned.py``) probe every member generation
+        per digest but count ONE hit or miss for the whole lookup —
+        per-member counting would inflate the metrics by the partition
+        count.  Refreshes the LRU position like a real hit."""
+        with self._lock:
+            row = self._rows.get(digest)
+            if row is not None:
+                self._rows.move_to_end(digest)
+            return row
+
     # -- writes ------------------------------------------------------------
 
     def store(self, digest: str, uid: str, results: List[dict],

@@ -27,6 +27,9 @@ from . import admission
 
 Handler = Callable[[dict], dict]  # AdmissionRequest -> AdmissionResponse
 
+SCANNER_HOT_SWAPS = 'kyverno_tpu_scanner_hot_swaps_total'
+BREAKER_MIGRATIONS = 'kyverno_tpu_breaker_migrations_total'
+
 
 # ---------------------------------------------------------------------------
 # block / warning assembly (reference: pkg/webhooks/utils/block.go,
@@ -312,6 +315,11 @@ class ResourceHandlers:
         self._scanners: 'collections.OrderedDict[tuple, Any]' = \
             collections.OrderedDict()
         self._scanners_max = 8
+        # (namespace, name) identity sets per cached scanner key: policy
+        # churn replaces the Policy OBJECTS (so the id()-tuple key never
+        # matches), but the logical set persists — the hot-swap
+        # predecessor search matches on identity overlap
+        self._scanner_ident: Dict[tuple, frozenset] = {}
         self._building: set = set()
         # per-policy-set circuit breakers (serving/breaker.py): a set
         # that keeps failing (build or scan) opens and serves the host
@@ -411,10 +419,8 @@ class ResourceHandlers:
                     # hits (AOT-loads from the persistent executable store
                     # when a prior process already compiled this set)
                     scanner.warmup()
-                with self._scanner_lock:
-                    while len(self._scanners) >= self._scanners_max:
-                        self._scanners.popitem(last=False)
-                    self._scanners[key] = scanner
+                self._install_scanner(key, base, kind, policies,
+                                      scanner)
             except Exception as e:  # noqa: BLE001
                 # a policy set that cannot compile must trip the circuit
                 # breaker, or every request re-spawns a doomed
@@ -434,6 +440,73 @@ class ResourceHandlers:
             # frees for the next window
             self._breakers.probe_abort(base)
         return None
+
+    def _install_scanner(self, key: tuple, base: tuple, kind: str,
+                         policies, scanner) -> None:
+        """Insert a freshly built scanner, hot-swapping any live
+        predecessor serving the same logical policy set.
+
+        Policy churn replaces the Policy objects, so the successor's
+        id()-tuple key never matches the predecessor's — the logical
+        set is matched by (namespace, name) identity overlap instead.
+        The swap is atomic under the scanner lock AFTER the successor
+        is fully built and warmed: requests keep riding the predecessor
+        (or the host loop, with identical verdicts) until the flip, so
+        a churn event never sheds and never 500s.  In-flight batches
+        hold direct references to the predecessor and drain naturally.
+        Breaker state migrates to the successor's key instead of
+        resetting to closed — a backend fault that tripped the old
+        serial must not be forgiven by recompiling the policy set."""
+        from ..observability.metrics import global_registry
+        ident = frozenset((p.namespace, p.name) for p in policies)
+        swapped = None
+        with self._scanner_lock:
+            best, best_ratio = None, 0.0
+            for k in self._scanners:
+                if k[0] != key[0] or k == key:
+                    continue
+                prev = self._scanner_ident.get(k)
+                if not prev:
+                    continue
+                ratio = len(ident & prev) / max(len(ident), len(prev), 1)
+                if ratio > best_ratio:
+                    best, best_ratio = k, ratio
+            if best is not None and best_ratio >= 0.5:
+                old = self._scanners.pop(best)
+                self._scanner_ident.pop(best, None)
+                state = self._breakers.migrate(best[1:], base,
+                                               policies=policies)
+                swapped = (old, state)
+            while len(self._scanners) >= self._scanners_max:
+                evicted, _ = self._scanners.popitem(last=False)
+                self._scanner_ident.pop(evicted, None)
+            self._scanners[key] = scanner
+            self._scanner_ident[key] = ident
+        if swapped is None:
+            return
+        old, state = swapped
+        reg = global_registry()
+        if reg is not None:
+            reg.inc(SCANNER_HOT_SWAPS, kind=kind)
+            reg.inc(BREAKER_MIGRATIONS)
+        touched = None
+        old_pset = getattr(old, '_pset', None)
+        new_pset = getattr(scanner, '_pset', None)
+        if old_pset is not None and new_pset is not None:
+            from ..partition.plan import diff_plans
+            touched = diff_plans(old_pset.plan, new_pset.plan).touched
+        from ..partition import census as partition_census
+        partition_census.record_swap(
+            kind, getattr(old, 'serial', None),
+            getattr(scanner, 'serial', None),
+            breaker_state=state, touched=touched)
+        import logging
+        from ..observability.logging import with_values
+        with_values(logging.getLogger('kyverno.webhooks'),
+                    'scanner hot-swap', kind=kind,
+                    old_serial=getattr(old, 'serial', None),
+                    new_serial=getattr(scanner, 'serial', None),
+                    breaker_state=state)
 
     def _record_key_failure(self, key: tuple, policies, reason: str) -> None:
         import logging

@@ -707,6 +707,66 @@ def test_ktpu507_dead_stage_entries(tmp_path):
     assert len(rep.active) == len(load_stage_registry())
 
 
+# -- KTPU508: partition key hygiene ------------------------------------------
+
+def test_ktpu508_direct_whole_set_fingerprint(tmp_path):
+    rep = run(tmp_path, {'ops/e.py': """\
+    def build(cps, aot, packed):
+        key = aot.executable_cache_key(
+            policy_set_fingerprint(cps.policies), packed)
+        return key
+    """}, rules=['KTPU508'])
+    assert rule_ids(rep) == {'KTPU508'}
+
+
+def test_ktpu508_resolves_binding_in_enclosing_scope(tmp_path):
+    # the ops/eval.py shape: the fingerprint binds in the builder
+    # function, the cache-key call sits in a nested closure
+    rep = run(tmp_path, {'ops/e.py': """\
+    def build_evaluator(cps, aot):
+        fingerprint = policy_set_fingerprint(cps.policies)
+
+        def _compiled_for(packed):
+            return aot.executable_cache_key(fingerprint, packed)
+        return _compiled_for
+    """}, rules=['KTPU508'])
+    assert rule_ids(rep) == {'KTPU508'}
+
+
+def test_ktpu508_compile_fingerprint_is_clean(tmp_path):
+    rep = run(tmp_path, {'ops/e.py': """\
+    def build_evaluator(cps, aot):
+        from ..partition.keys import compile_fingerprint
+        fingerprint = compile_fingerprint(cps)
+
+        def _compiled_for(packed):
+            return aot.executable_cache_key(fingerprint, packed)
+        return _compiled_for
+    """}, rules=['KTPU508'])
+    assert not rep.active
+
+
+def test_ktpu508_partition_package_is_exempt(tmp_path):
+    # partition/ IS the sanctioned fingerprint authority: the
+    # degenerate whole-set spelling inside it is the oracle path
+    rep = run(tmp_path, {'partition/keys.py': """\
+    def compile_fingerprint(cps, aot, packed):
+        return aot.executable_cache_key(
+            policy_set_fingerprint(cps.policies), packed)
+    """}, rules=['KTPU508'])
+    assert not rep.active
+
+
+def test_ktpu508_parameter_fingerprint_undecidable(tmp_path):
+    # a fingerprint arriving as a parameter resolves nowhere — the
+    # one-level pass stays silent instead of guessing
+    rep = run(tmp_path, {'ops/e.py': """\
+    def lookup(aot, fingerprint, packed):
+        return aot.executable_cache_key(fingerprint, packed)
+    """}, rules=['KTPU508'])
+    assert not rep.active
+
+
 # -- KTPU00x: suppression hygiene (meta rules) -------------------------------
 
 def test_ktpu001_positive_negative(tmp_path):
@@ -850,7 +910,7 @@ def test_rule_registry_complete():
                 'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
                 'KTPU401', 'KTPU402',
                 'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505',
-                'KTPU506', 'KTPU507'}
+                'KTPU506', 'KTPU507', 'KTPU508'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
