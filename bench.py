@@ -2558,7 +2558,209 @@ def admission_chaos_main(platform: str) -> int:
     return 0
 
 
+# -- multichip mesh bench (bench.py --multichip) ------------------------------
+
+#: THE RATCHET: windowed mean shard skew (max-shard wall / mean-shard
+#: wall, averaged over the analyzer window) on a real multi-device run
+#: must stay under this — a fleet whose slowest chip runs at half the
+#: mean is losing that capacity on every step.  The forced-CPU mesh
+#: (8 virtual devices on one host) walks its shard waits serially, so
+#: shard 0 absorbs the whole compute wall and the ratio is meaningless
+#: there; the ratchet only arms off the forced-CPU path.  The measured
+#: value is always recorded.
+MESH_SKEW_RATIO_MAX = float(os.environ.get('MESH_SKEW_RATIO_MAX', '1.5'))
+
+#: rows per mesh step in the multichip block
+MULTICHIP_ROWS = int(os.environ.get('BENCH_MULTICHIP_N', '1024'))
+MULTICHIP_STEPS = int(os.environ.get('BENCH_MULTICHIP_STEPS', '3'))
+
+MULTICHIP_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'MULTICHIP_r06.json')
+
+
+def _fleet_child(path: str, rows: int) -> None:
+    """One federation 'host': run a small mesh workload under its own
+    fleet registry and leave a JSONL snapshot behind.  Top-level so
+    multiprocessing spawn can import it from a fresh interpreter."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import random
+    from kyverno_tpu.api.policy import load_policies_from_yaml
+    from kyverno_tpu.compiler.compile import compile_policies
+    from kyverno_tpu.observability import fleet
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+    from kyverno_tpu.parallel.mesh import distributed_scan_step, make_mesh
+    reg = MetricsRegistry()
+    # no auto-profile in the drill child: the capture thread holds the
+    # jax profiler across interpreter teardown
+    fleet.configure(reg, window=2, profile_trigger=lambda: None)
+    cps = compile_policies(load_policies_from_yaml(PACK))
+    mesh = make_mesh()
+    rng = random.Random(os.getpid())
+    pods = [make_pod(rng, i) for i in range(rows)]
+    for _ in range(2):
+        distributed_scan_step(cps, mesh, pods)
+    fleet.write_snapshot(path, reg)
+    # skip interpreter teardown: the spawned XLA CPU client segfaults
+    # in its destructor and the snapshot is already on disk
+    os._exit(0)
+
+
+def _federation_roundtrip(tmpdir: str) -> dict:
+    """Spawn two single-host processes, merge their JSONL snapshots
+    offline, and check the merge is lossless: every counter's merged
+    total equals the sum of the per-host totals."""
+    import multiprocessing as mp
+    from kyverno_tpu.observability import fleet
+    paths = [os.path.join(tmpdir, f'bench_host{i}.jsonl')
+             for i in range(2)]
+    for p in paths:
+        if os.path.exists(p):
+            os.remove(p)
+    ctx = mp.get_context('spawn')
+    procs = [ctx.Process(target=_fleet_child, args=(p, 64))
+             for p in paths]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=240)
+    rcs = [p.exitcode for p in procs]
+    docs = fleet.read_snapshot_files([p for p in paths
+                                      if os.path.exists(p)])
+    merged = fleet.FleetRegistry.merge(docs)
+    merged_totals = fleet.FleetRegistry.counter_totals(merged)
+    per_host = [fleet.FleetRegistry.counter_totals(d) for d in docs]
+    names = sorted({n for t in per_host for n in t})
+    lossless = len(docs) == 2 and all(
+        abs(sum(t.get(n, 0.0) for t in per_host)
+            - merged_totals.get(n, 0.0)) <= 1e-9 * max(
+                1.0, abs(merged_totals.get(n, 0.0)))
+        for n in names)
+    return {
+        'hosts': len(docs), 'child_exitcodes': rcs,
+        'counters_checked': len(names), 'lossless': bool(lossless),
+        'merged_counter_totals': {n: merged_totals.get(n, 0.0)
+                                  for n in names},
+    }
+
+
+def multichip_main() -> int:
+    """``bench.py --multichip``: the mesh block — decisions/s vs device
+    count, per-shard skew + straggler verdict, collective share,
+    padding waste, and the two-process federation round-trip; written
+    to MULTICHIP_r06.json (replacing the dryrun-only r01–r05 series)."""
+    platform = os.environ.get('BENCH_PLATFORM') or probe_platform()
+    forced_cpu = platform == 'cpu'
+    if forced_cpu:
+        # 8 virtual CPU devices — must land before backend init
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8').strip()
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+    import random
+    import jax
+    from kyverno_tpu.observability import fleet
+    from kyverno_tpu.observability.metrics import MetricsRegistry
+    from kyverno_tpu.parallel.mesh import distributed_scan_step, make_mesh
+    devices = jax.devices()
+    rng = random.Random(7)
+    pods = [make_pod(rng, i) for i in range(MULTICHIP_ROWS)]
+    # each mesh size is its own compile, so the default pack is the
+    # small self-contained one; BENCH_MULTICHIP_PACK=full opts into the
+    # reference pack (minutes of compile across the device sweep)
+    policies = []
+    if os.environ.get('BENCH_MULTICHIP_PACK', '') == 'full':
+        try:
+            policies = load_policy_pack()
+        except Exception:  # noqa: BLE001 - reference tree may be absent
+            policies = []
+    if not policies:
+        from kyverno_tpu.api.policy import load_policies_from_yaml
+        policies = load_policies_from_yaml(PACK)
+    from kyverno_tpu.compiler.compile import compile_policies
+    cps = compile_policies(policies)
+    scaling = []
+    verdict = None
+    collective_share = 0.0
+    padding_rows = 0.0
+    counts = [k for k in (1, 2, 4, 8) if k <= len(devices)]
+    for k in counts:
+        reg = MetricsRegistry()
+        # forced-CPU meshes sustain 'skew' by construction (shard 0
+        # absorbs the serial compute) — a real auto-profile capture
+        # here would sample for seconds inside the timed loop
+        fleet.configure(reg, window=max(2, MULTICHIP_STEPS),
+                        profile_trigger=lambda: None)
+        mesh = make_mesh(devices[:k])
+        _progress(f'multichip: mesh data{k} warmup')
+        distributed_scan_step(cps, mesh, pods)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(MULTICHIP_STEPS):
+            distributed_scan_step(cps, mesh, pods)
+        wall = time.perf_counter() - t0
+        per_s = MULTICHIP_ROWS * MULTICHIP_STEPS * len(cps.programs) / wall
+        snap = reg.snapshot(fleet.identity())
+        totals = fleet.FleetRegistry.counter_totals(snap)
+        coll = totals.get(fleet.MESH_COLLECTIVE_SECONDS, 0.0)
+        scaling.append({
+            'n_devices': k, 'rows': MULTICHIP_ROWS,
+            'steps': MULTICHIP_STEPS,
+            'decisions_per_s': round(per_s, 1),
+            'wall_s': round(wall, 4),
+            'collective_share': round(coll / wall, 4) if wall else 0.0,
+        })
+        analyzer = fleet.analyzer()
+        if k == counts[-1] and analyzer is not None:
+            verdict = analyzer.verdict()
+            collective_share = round(coll / wall, 4) if wall else 0.0
+            padding_rows = totals.get(fleet.MESH_PADDING_ROWS, 0.0)
+    fed_dir = os.path.join(os.path.dirname(MULTICHIP_OUT), '.cache',
+                           'fleet')
+    os.makedirs(fed_dir, exist_ok=True)
+    federation = _federation_roundtrip(fed_dir)
+    skew = float((verdict or {}).get('window_mean_skew', 1.0))
+    armed = not forced_cpu and len(devices) > 1
+    ok = federation['lossless'] and \
+        (not armed or skew <= MESH_SKEW_RATIO_MAX)
+    result = {
+        'metric': 'multichip_mesh',
+        'platform': platform,
+        'forced_cpu_mesh': forced_cpu,
+        'n_devices': len(devices),
+        'mesh': {
+            'scaling': scaling,
+            'skew': verdict,
+            'window_mean_skew': skew,
+            'collective_share': collective_share,
+            'padding_rows_total': padding_rows,
+            'federation': federation,
+        },
+        'ratchet': {
+            'mesh_skew_ratio_max': MESH_SKEW_RATIO_MAX,
+            'armed': armed,
+            'measured': skew,
+        },
+        'ok': bool(ok),
+    }
+    with open(MULTICHIP_OUT, 'w') as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write('\n')
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def main() -> int:
+    # --multichip runs before any backend / telemetry setup: the forced
+    # 8-virtual-device XLA_FLAGS must land before jax initializes
+    if '--multichip' in sys.argv[1:]:
+        try:
+            return multichip_main()
+        except Exception as e:  # noqa: BLE001 - always emit a JSON line
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({'metric': 'multichip_mesh',
+                              'error': f'{type(e).__name__}: {e}'}))
+            return 1
     # the BASELINE.md north star is a 1M-Pod background scan; BENCH_N
     # caps the pods, BENCH_BUDGET_S caps the measured streaming time —
     # whichever hits first ends the run, so the bench ALWAYS finishes

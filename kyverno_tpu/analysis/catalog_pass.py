@@ -43,6 +43,15 @@ module API, and exit codes are unchanged).
   fingerprint in a compile/AOT key means one policy edit invalidates
   every partition's executables; draw it from
   ``partition/keys.compile_fingerprint`` instead.
+* **KTPU509** — fleet-scope hygiene: metrics written from the mesh
+  path (``kyverno_tpu/parallel/``) feed the cross-host federation
+  (``observability/fleet.py``), so without a shard/host identity label
+  the merged view collapses every process's series into one lying
+  number.  The catalog's ``fleet_scope`` field names the required
+  label key (``shard`` / ``mesh``); the pass flags a parallel/ write
+  of a metric with no declared scope, any write of a scoped metric
+  missing its identity keyword, and a declared scope no parallel/
+  write site exercises (dead scope, the KTPU503/505 analogue).
 * **KTPU506** — unit mismatch at a write site: a cataloged metric whose
   name declares its unit (``*_seconds[_total]`` / ``*_bytes[_total]``)
   is fed a value that carries the wrong one — a ``*_ms`` name with no
@@ -77,6 +86,10 @@ DEAD_METRIC_ALLOWLIST = {
     'kyverno_client_queries_total':
         'reserved for a real cluster client transport (dclient '
         'interface exists; the in-memory fake does not emit queries)',
+    'kyverno_tpu_metric_series_dropped_total':
+        'written by the registry cardinality guard itself '
+        '(metrics.py:_admit) through direct series access — an inc() '
+        'there would recurse into the guard',
 }
 
 
@@ -342,6 +355,112 @@ def _check_dead_spans(ctx: Context) -> Iterable[Finding]:
             'KTPU505', line,
             f'span catalog: {name!r} has no start site in the tree — '
             f'remove the entry or add the span')
+
+
+# -- fleet-scope hygiene (KTPU509) --------------------------------------------
+
+def load_fleet_scopes() -> Dict[str, str]:
+    """Cataloged metrics that declare a ``fleet_scope`` — the identity
+    label key every write site must pass so cross-host federation can
+    tell the series apart."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from kyverno_tpu.observability.catalog import METRICS
+    return {name: m.fleet_scope for name, m in METRICS.items()
+            if getattr(m, 'fleet_scope', '')}
+
+
+def collect_labeled_writes(files: List[SourceFile]
+                           ) -> List[Tuple[SourceFile, int, str,
+                                           Optional[frozenset]]]:
+    """Resolved metric write sites with the label keys they pass:
+    ``[(file, line, metric_name, label_keys)]``.  ``label_keys`` is
+    None when the site splats ``**labels`` (uncheckable keys)."""
+    all_consts: Dict[str, str] = {}
+    for sf in files:
+        if sf.tree is not None:
+            all_consts.update(_module_constants(sf.tree))
+    sites: List[Tuple[SourceFile, int, str, Optional[frozenset]]] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        local_consts = _module_constants(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in WRITE_METHODS and node.args):
+                continue
+            arg = node.args[0]
+            name: Optional[str] = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.Name):
+                name = local_consts.get(arg.id, all_consts.get(arg.id))
+            elif isinstance(arg, ast.Attribute):
+                name = all_consts.get(arg.attr)
+            if name is None:
+                continue  # KTPU502's finding, not ours
+            keys: Optional[frozenset]
+            if any(kw.arg is None for kw in node.keywords):
+                keys = None  # **labels splat — keys unknowable
+            else:
+                keys = frozenset(kw.arg for kw in node.keywords)
+            sites.append((sf, node.lineno, name, keys))
+    return sites
+
+
+@register('KTPU509', 'fleet-scope hygiene: a parallel/ metric write '
+                     'with no shard/host identity scope, a scoped '
+                     'write missing its identity label, or a dead '
+                     'fleet_scope')
+def _check_fleet_scope(ctx: Context) -> Iterable[Finding]:
+    scopes = load_fleet_scopes()
+    sites = collect_labeled_writes(ctx.files)
+    exercised: set = set()
+    for sf, line, name, keys in sites:
+        rel = '/' + sf.rel.replace(os.sep, '/')
+        in_parallel = '/parallel/' in rel
+        scope = scopes.get(name)
+        if in_parallel:
+            if scope is None:
+                yield sf.finding(
+                    'KTPU509', line,
+                    f'metric {name!r} is written from parallel/ but '
+                    f'declares no fleet_scope in the catalog — '
+                    f'without a shard/host identity label the '
+                    f'cross-host federation merges every process '
+                    f'into one series')
+                continue
+            exercised.add(name)
+        if scope is not None and keys is not None and scope not in keys:
+            yield sf.finding(
+                'KTPU509', line,
+                f'metric {name!r} declares fleet_scope='
+                f'{scope!r} but this write site passes no '
+                f'{scope}=... label — the federated series from '
+                f'different shards/meshes would collide')
+    anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
+
+    def locate(name):
+        target = anchor if anchor is not None else ctx.files[0]
+        line = 1
+        if anchor is not None:
+            for i, text in enumerate(anchor.lines, start=1):
+                if f"'{name}'" in text:
+                    line = i
+                    break
+        return target, line
+
+    for name in sorted(scopes):
+        if name in exercised:
+            continue
+        target, line = locate(name)
+        yield target.finding(
+            'KTPU509', line,
+            f'catalog: {name} declares fleet_scope='
+            f'{scopes[name]!r} but no parallel/ write site exercises '
+            f'it — drop the scope or move the emitter onto the mesh '
+            f'path')
 
 
 # -- pipeline stage registry (KTPU507) ----------------------------------------

@@ -145,6 +145,54 @@ def executable_cache_key(fingerprint: str, packed: Dict[str, Any],
 # -- XLA persistent compilation cache ---------------------------------------
 
 _PERSISTENT_CACHE_ON = False
+_PERSISTENT_CACHE_DIR: Optional[str] = None
+
+#: counted when the feature guard refuses a cache directory (same
+#: series the AOT executable store uses for its load rejections)
+AOT_LOAD_REJECTED = 'kyverno_tpu_aot_load_rejected_total'
+
+#: marker file recording which host CPU feature set populated a
+#: persistent-cache directory
+HOSTKEY_FILE = 'HOSTKEY'
+
+
+def verify_cache_feature_scope(cache_dir: str) -> Tuple[str, bool]:
+    """Feature guard for a persistent-XLA-cache directory.
+
+    The default cache dir is already scoped by the env digest, but an
+    operator-pinned ``KTPU_COMPILE_CACHE`` shared across heterogeneous
+    machines is not — and XLA:CPU entries embed the compile host's CPU
+    features, so loading across that boundary risks SIGILL (the
+    MULTICHIP dryrun tails).  A ``HOSTKEY`` marker records which
+    feature set populated the directory; on mismatch the dir is
+    re-scoped to a ``feat-<digest>`` subdirectory and the rejection
+    counts on ``kyverno_tpu_aot_load_rejected_total{reason=
+    feature_mismatch}``.  Returns ``(usable_dir, rejected)``."""
+    fp = host_fingerprint()
+    marker = os.path.join(cache_dir, HOSTKEY_FILE)
+    recorded: Optional[str] = None
+    try:
+        with open(marker) as f:
+            recorded = f.read().strip()
+    except OSError:
+        pass
+    if recorded is not None and recorded != fp:
+        from ..observability.metrics import global_registry
+        registry = global_registry()
+        if registry is not None:
+            registry.inc(AOT_LOAD_REJECTED, reason='feature_mismatch')
+        cache_dir = os.path.join(cache_dir, f'feat-{fp}')
+        rejected = True
+    else:
+        rejected = False
+    if recorded != fp:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(os.path.join(cache_dir, HOSTKEY_FILE), 'w') as f:
+                f.write(fp)
+        except OSError:
+            pass
+    return cache_dir, rejected
 
 
 def enable_persistent_compilation_cache() -> Optional[str]:
@@ -154,7 +202,9 @@ def enable_persistent_compilation_cache() -> Optional[str]:
     accelerators).  Keyed by XLA on the computation fingerprint, which
     covers the (policy-set, chunk-shape) pair.  Idempotent; returns the
     cache dir (or None when the runtime lacks the knobs)."""
-    global _PERSISTENT_CACHE_ON
+    global _PERSISTENT_CACHE_ON, _PERSISTENT_CACHE_DIR
+    if _PERSISTENT_CACHE_ON:
+        return _PERSISTENT_CACHE_DIR
     # scope by host CPU features AND the codegen-relevant environment:
     # a TPU-plugin process compiles its CPU executables with different
     # machine-feature preferences (prefer-no-gather/scatter) than a
@@ -165,14 +215,17 @@ def enable_persistent_compilation_cache() -> Optional[str]:
         os.path.join(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))), '.cache',
             f'xla-{scope}'))
-    if _PERSISTENT_CACHE_ON:
-        return cache_dir
     try:
         os.makedirs(cache_dir, exist_ok=True)
+        # a dir populated by a different CPU feature set (pinned
+        # KTPU_COMPILE_CACHE on a shared checkout) is re-scoped, not
+        # trusted — its entries could SIGILL this host
+        cache_dir, _rejected = verify_cache_feature_scope(cache_dir)
         jax.config.update('jax_compilation_cache_dir', cache_dir)
         jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
         jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
     except Exception:  # noqa: BLE001 - cache is an optimization only
         return None
     _PERSISTENT_CACHE_ON = True
+    _PERSISTENT_CACHE_DIR = cache_dir
     return cache_dir

@@ -78,6 +78,11 @@ class Setup:
             # admission-latency SLO engine (GET /debug/slo; off unless
             # KTPU_SLO_WINDOW_S > 0)
             slo.configure(self.metrics)
+            # fleet observatory: mesh-step telemetry, straggler blame +
+            # cross-host federation (GET /debug/fleet; KTPU_FLEET=0
+            # pins it off)
+            from ..observability import fleet
+            fleet.configure(self.metrics)
         self.configuration = Configuration()
         if client is None:
             from ..dclient.client import FakeClient

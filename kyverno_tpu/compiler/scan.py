@@ -849,6 +849,17 @@ class BatchScanner:
             s, d, fd = out
             if self.mesh is not None:
                 import jax
+                from ..observability import fleet
+                shard_walls = None
+                t_coll = 0.0
+                padded_rows = int(s.shape[0])
+                if fleet.enabled():
+                    # mesh-path telemetry (fleet observatory): time
+                    # each shard's readback wait, then the collective
+                    # leg — pure timing, the values are untouched
+                    from ..parallel.mesh import shard_wait_splits
+                    shard_walls = shard_wait_splits(s)
+                    t_coll = time.perf_counter()
                 if jax.process_count() > 1:
                     # multi-host mesh: each process only holds its
                     # local shards of the batch axis — gather the
@@ -860,6 +871,11 @@ class BatchScanner:
                     d = multihost_utils.process_allgather(d, tiled=True)
                     fd = multihost_utils.process_allgather(fd,
                                                            tiled=True)
+                if shard_walls is not None:
+                    from ..parallel.mesh import record_sharded_dispatch
+                    record_sharded_dispatch(
+                        self.mesh, 'data', ln, padded_rows, shard_walls,
+                        time.perf_counter() - t_coll)
             with devtel.d2h_guard({'chunk_start': start,
                                    'rows': ln}) as g:
                 s, d, fd = (np.array(s)[:ln], np.array(d)[:ln],

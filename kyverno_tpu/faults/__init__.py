@@ -12,7 +12,7 @@ by test doubles.
 Spec grammar (clauses separated by ``;``, fields by ``,``)::
 
     site=<name>[,p=<prob>][,nth=<call>][,marker=<label>]
-        [,error=<class>][,seed=<int>][,exhaust=1]
+        [,error=<class>][,seed=<int>][,exhaust=1][,delay_ms=<ms>]
 
 * ``p``      — fire with probability ``p`` per check, drawn
   deterministically from ``seed`` and the site's call counter (the
@@ -27,6 +27,12 @@ Spec grammar (clauses separated by ``;``, fields by ``,``)::
   them) instead of per call.
 * ``error``  — error class name (:data:`ERROR_CLASSES`); default
   ``RuntimeError``.  Injected errors carry ``ktpu_injected = True``.
+* ``delay_ms`` — fire as a *stall* instead of an error: the check
+  sleeps ``delay_ms`` milliseconds and returns.  This is how a chaos
+  schedule plants a deterministic straggler (a slow shard/stage is a
+  different failure mode than a dead one — the ``mesh_shard`` site
+  uses it to inflate exactly one shard's device-eval wall so the
+  fleet skew analyzer can be exercised end to end).
 * ``exhaust`` — mark the injected error retry-exhausted
   (``ktpu_retry_exhausted = True``), the shape a pipeline stage
   reports after burning its ``KTPU_STAGE_RETRIES`` budget.  The
@@ -59,10 +65,15 @@ SITE_AOT_LOAD = 'aot_load'
 SITE_VERDICT_SNAPSHOT = 'verdict_snapshot_read'
 SITE_BATCHER_DISPATCH = 'batcher_dispatch'
 SITE_WEBHOOK_HANDLER = 'webhook_handler'
+#: checked once per shard inside the mesh per-shard readback-timing
+#: loop (parallel/mesh.py) — the Nth check is shard (N-1) % mesh_size
+#: of step (N-1) // mesh_size, so an nth+delay_ms clause stalls one
+#: specific shard of one specific step, deterministically
+SITE_MESH_SHARD = 'mesh_shard'
 
 SITES = (SITE_ENCODE, SITE_H2D, SITE_DEVICE_EVAL, SITE_D2H,
          SITE_AOT_LOAD, SITE_VERDICT_SNAPSHOT, SITE_BATCHER_DISPATCH,
-         SITE_WEBHOOK_HANDLER)
+         SITE_WEBHOOK_HANDLER, SITE_MESH_SHARD)
 
 #: the label key :func:`check_rows` inspects for ``marker`` clauses
 MARKER_LABEL = 'chaos'
@@ -84,11 +95,12 @@ class FaultSpecError(ValueError):
 
 class _Clause:
     __slots__ = ('site', 'p', 'nth', 'marker', 'error', 'seed',
-                 'exhaust', 'fired')
+                 'exhaust', 'delay_ms', 'fired')
 
     def __init__(self, site: str, p: Optional[float], nth: Optional[int],
                  marker: Optional[str], error: type, seed: int,
-                 exhaust: bool = False):
+                 exhaust: bool = False,
+                 delay_ms: Optional[float] = None):
         self.site = site
         self.p = p
         self.nth = nth
@@ -96,6 +108,7 @@ class _Clause:
         self.error = error
         self.seed = seed
         self.exhaust = exhaust
+        self.delay_ms = delay_ms
         self.fired = 0
 
 
@@ -127,6 +140,8 @@ def parse(spec: str) -> List[_Clause]:
             nth = int(fields.pop('nth')) if 'nth' in fields else None
             seed = int(fields.pop('seed', '0'))
             exhaust = bool(int(fields.pop('exhaust', '0')))
+            delay_ms = float(fields.pop('delay_ms')) \
+                if 'delay_ms' in fields else None
         except ValueError as e:
             raise FaultSpecError(
                 f'bad numeric field in fault clause {part!r}: {e}')
@@ -146,8 +161,11 @@ def parse(spec: str) -> List[_Clause]:
                 f'fault clause {part!r} needs one of p=, nth=, marker=')
         if p is not None and not (0.0 <= p <= 1.0):
             raise FaultSpecError(f'p={p} outside [0, 1] in {part!r}')
+        if delay_ms is not None and delay_ms < 0:
+            raise FaultSpecError(f'delay_ms={delay_ms} negative in '
+                                 f'{part!r}')
         clauses.append(_Clause(site, p, nth, marker, error, seed,
-                               exhaust))
+                               exhaust, delay_ms))
     return clauses
 
 
@@ -172,6 +190,12 @@ class Injector:
         registry = _registry()
         if registry is not None:
             registry.inc(FAULTS_INJECTED, site=clause.site)
+        if clause.delay_ms is not None:
+            # stall semantics: the injected failure is slowness, not an
+            # error — the caller proceeds after the sleep
+            import time
+            time.sleep(clause.delay_ms / 1000.0)
+            return
         err = clause.error(
             f'injected fault at {clause.site} ({detail})')
         err.ktpu_injected = True

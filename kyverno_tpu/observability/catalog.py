@@ -20,6 +20,15 @@ from typing import Dict, NamedTuple
 class Metric(NamedTuple):
     type: str  # counter | gauge | histogram
     help: str
+    #: fleet identity axis for metrics emitted on the sharded mesh path
+    #: (``kyverno_tpu/parallel/``): the label key every write site must
+    #: carry so cross-host federation can tell series apart —
+    #: ``'shard'`` (one series per mesh shard) or ``'mesh'`` (one
+    #: series per mesh shape).  '' for single-host metrics.  Enforced
+    #: by ktpu-lint KTPU509 (write sites under parallel/ must use a
+    #: fleet-scoped metric and pass its label; a declared scope with no
+    #: parallel/ write site is dead).
+    fleet_scope: str = ''
 
 
 METRICS: Dict[str, Metric] = {
@@ -206,6 +215,32 @@ METRICS: Dict[str, Metric] = {
         'stage: seconds of scan wall the timeline walk attributed to '
         'stage= (executing or gated-waiting while on the e2e critical '
         'path); per-scan fractions drive the bottleneck advisor.'),
+    # mesh-step telemetry (parallel/mesh.py, observability/fleet.py)
+    'kyverno_tpu_mesh_step_duration_seconds': Metric(
+        'histogram', 'Sharded-dispatch wall per mesh step: one series '
+        'per shard index with that shard\'s device-eval wait '
+        '(host-side block_until_ready split, arrival order), plus '
+        'shard=all for the whole step.', fleet_scope='shard'),
+    'kyverno_tpu_mesh_shard_skew_ratio': Metric(
+        'gauge', 'Max-shard / mean-shard device-eval wall of the most '
+        'recent mesh step, per mesh shape — 1.0 is a perfectly '
+        'balanced step; the fleet skew analyzer windows this '
+        '(KTPU_FLEET_SKEW_WINDOW) to name stragglers.',
+        fleet_scope='mesh'),
+    'kyverno_tpu_mesh_collective_seconds_total': Metric(
+        'counter', 'Cumulative wall spent in cross-shard collectives '
+        '(psum\'d summary readback + multi-host allgather) per mesh '
+        'shape.', fleet_scope='mesh'),
+    'kyverno_tpu_mesh_padding_rows_total': Metric(
+        'counter', 'Rows added to pad mesh batches up to a multiple '
+        'of the mesh size (canonical capacity included) — wasted '
+        'device work per mesh shape.', fleet_scope='mesh'),
+    # registry self-protection (observability/metrics.py)
+    'kyverno_tpu_metric_series_dropped_total': Metric(
+        'counter', 'New label-sets refused because a metric already '
+        'held KTPU_METRIC_SERIES_MAX distinct series, by metric= — '
+        'per-host/per-shard labels cannot explode the registry under '
+        'a large fleet.'),
     # serving SLO engine (observability/slo.py)
     'kyverno_tpu_slo_burn_rate': Metric(
         'gauge', 'Admission-latency error-budget burn rate '
@@ -246,6 +281,10 @@ SPANS: Dict[str, str] = {
     'kyverno/mutate/decode': 'Device mutate decode stage: edit '
                              'bitmasks to patched JSON + engine '
                              'responses.',
+    'kyverno/mesh/step': 'One sharded mesh dispatch '
+                         '(distributed_scan_step): carries mesh '
+                         'shape, per-shard row occupancy, skew ratio '
+                         'and the blamed straggler shard.',
     'kyverno/rescan': 'One background reconcile tick (verdict-cache '
                       'filter + dense scan of the misses).',
     'kyverno/background/ur': 'One UpdateRequest sync.',

@@ -12,11 +12,22 @@ Per-metric disable/relabel follows the dynamic metrics configuration
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
                     2.5, 5.0, 10.0)
+
+#: overflow counter of the label-cardinality guard (KTPU_METRIC_SERIES_MAX)
+SERIES_DROPPED = 'kyverno_tpu_metric_series_dropped_total'
+
+
+def _series_max() -> int:
+    try:
+        return int(os.environ.get('KTPU_METRIC_SERIES_MAX', '512'))
+    except ValueError:
+        return 512
 
 #: compile/scan-scale buckets: fresh-cache policy-set compiles measure
 #: 43-49s (STATUS.md) — the default buckets top out at 10s and every
@@ -34,6 +45,25 @@ class MetricsRegistry:
         self._buckets: Dict[str, Tuple[float, ...]] = {}
         self._disabled = set(disabled or [])
         self._reset_on_close: set = set()
+        # label-cardinality guard: per-host/per-shard labels under a
+        # large fleet must not explode the registry, so a metric caps
+        # out at this many distinct label-sets — existing series keep
+        # updating, NEW series beyond the cap are refused and counted
+        self._series_cap = _series_max()
+
+    def _admit(self, store: Dict[str, Dict[Tuple, Any]], name: str,
+               key: Tuple) -> bool:
+        """Under ``self._lock``: may ``(name, key)`` gain a series?
+        Overflow counts on the drop counter directly (bypassing the
+        guard — its own cardinality is bounded by the catalog)."""
+        series = store.get(name)
+        if series is None or key in series or \
+                len(series) < self._series_cap or name == SERIES_DROPPED:
+            return True
+        dropped = self._counters.setdefault(SERIES_DROPPED, {})
+        dkey = (('metric', name),)
+        dropped[dkey] = dropped.get(dkey, 0.0) + 1.0
+        return False
 
     def mark_reset_on_close(self, name: str) -> None:
         """Mark ``name`` as a *residency* gauge: it describes live
@@ -74,6 +104,8 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
+            if not self._admit(self._counters, name, key):
+                return
             series = self._counters.setdefault(name, {})
             series[key] = series.get(key, 0.0) + amount
 
@@ -85,6 +117,8 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
+            if not self._admit(self._gauges, name, key):
+                return
             self._gauges.setdefault(name, {})[key] = value
 
     def clear_gauge(self, name: str, **labels) -> None:
@@ -110,6 +144,8 @@ class MetricsRegistry:
             return
         key = tuple(sorted(labels.items()))
         with self._lock:
+            if not self._admit(self._hists, name, key):
+                return
             bounds = self._buckets.get(name, _DEFAULT_BUCKETS)
             series = self._hists.setdefault(name, {})
             entry = series.get(key)
@@ -150,6 +186,35 @@ class MetricsRegistry:
     def counter_total(self, name: str) -> float:
         with self._lock:
             return sum(self._counters.get(name, {}).values())
+
+    def snapshot(self, identity: Optional[Dict[str, Any]] = None) -> Dict:
+        """JSON-able point-in-time dump of every series, tagged with a
+        process ``identity`` ({host, pid, process_index}) — the unit of
+        cross-host federation (``observability/fleet.py``).  Label keys
+        serialize as ``[[k, v], ...]`` pairs; histogram entries carry
+        their bucket bounds so a merge can verify compatibility."""
+        with self._lock:
+            return {
+                'identity': dict(identity or {}),
+                'counters': {
+                    name: [[list(map(list, key)), value]
+                           for key, value in series.items()]
+                    for name, series in self._counters.items()},
+                'gauges': {
+                    name: [[list(map(list, key)), value]
+                           for key, value in series.items()]
+                    for name, series in self._gauges.items()},
+                'hists': {
+                    name: {
+                        'buckets': list(
+                            self._buckets.get(name, _DEFAULT_BUCKETS)),
+                        'series': [[list(map(list, key)), entry[0],
+                                    entry[1], list(entry[2])]
+                                   for key, entry in series.items()],
+                    }
+                    for name, series in self._hists.items()},
+                'reset_on_close': sorted(self._reset_on_close),
+            }
 
     def render(self) -> str:
         """Prometheus text exposition format."""

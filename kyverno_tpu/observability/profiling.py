@@ -325,6 +325,20 @@ def _r_slo(query):
     return _json_body(dict(eng.snapshot(), enabled=True))
 
 
+@debug_route('/debug/fleet',
+             'Fleet observatory: merged cross-host metric federation, '
+             'per-process snapshots and the mesh skew verdict (JSON; '
+             '`?format=table` for a terminal view).')
+def _r_fleet(query):
+    from . import fleet
+    fr = fleet.fleet()
+    if fr is None:
+        return _json_body({'enabled': False})
+    if query.get('format', [''])[0] == 'table':
+        return fr.render_table(), 'text/plain', 200
+    return _json_body(fr.report())
+
+
 @debug_route('/debug/profile',
              'On-demand deep profile (`?seconds=N`, clamped to 60s): '
              'py sampling profile + jax trace when a backend is live; '

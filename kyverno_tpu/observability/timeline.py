@@ -275,14 +275,27 @@ def analyze(events: Iterable[StageEvent], t0: float,
     }
 
 
-def advise(bound_by: str, frac: float) -> Tuple[Dict[str, str], str]:
+def advise(bound_by: str, frac: float,
+           detail: str = '') -> Tuple[Dict[str, str], str]:
     """Turn a blame verdict into concrete knob deltas.
 
     Returns ``(suggest, note)``: env-knob deltas worth trying plus a
     one-line rationale.  Deliberately coarse — the observatory names
     the wall to push on, the operator (or the bench sweep) confirms.
+    ``detail`` carries verdict-specific context (the fleet skew
+    analyzer passes the straggler's shard/device identity).
     """
     pct = f'{frac * 100:.0f}%'
+    if bound_by == 'straggler':
+        # fed by the fleet skew analyzer (observability/fleet.py):
+        # one shard's device-eval wall dominates a sustained window of
+        # mesh steps — no host-pipeline knob fixes a slow device
+        who = detail or 'one shard'
+        return ({},
+                f'mesh straggler: {who} carries {pct} excess '
+                f'device-eval wall over the skew window — rebalance '
+                f'or drain that device/host; deepening the host '
+                f'pipeline cannot help a slow shard')
     if bound_by == 'encode':
         return ({'KTPU_ENCODE_PROCS': '+2', 'KTPU_PIPELINE_DEPTH': '+1'},
                 f'host encode holds {pct} of the critical path: add '

@@ -13,6 +13,8 @@ all slices and GSPMD inserts DCN collectives for the summary only.
 
 from __future__ import annotations
 
+import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -136,6 +138,77 @@ def _cached_sharded_evaluator(cps: CompiledPolicySet, mesh: Mesh, axis: str):
     return step
 
 
+def shard_wait_splits(array) -> List[float]:
+    """Per-shard readback-wait splits: block on each addressable shard
+    of a just-dispatched sharded array in batch-axis order and time
+    each wait separately.  The split attributes wall to the shard the
+    host was actually waiting on (with all shards in flight, the shard
+    you block longest on IS the straggler); the ``mesh_shard`` fault
+    site is checked inside each timed split, so an injected
+    ``delay_ms`` clause inflates exactly one shard's wall."""
+    from .. import faults
+
+    def _order(sh):
+        try:
+            return sh.index[0].start or 0
+        except Exception:  # noqa: BLE001 - fall back to device ids
+            return getattr(sh.device, 'id', 0)
+
+    walls: List[float] = []
+    for sh in sorted(array.addressable_shards, key=_order):
+        t0 = time.perf_counter()
+        faults.check(faults.SITE_MESH_SHARD)
+        sh.data.block_until_ready()
+        walls.append(time.perf_counter() - t0)
+    return walls
+
+
+def record_sharded_dispatch(mesh: Mesh, axis: str, n_rows: int,
+                            padded_rows: int,
+                            shard_walls: List[float],
+                            collective_s: float,
+                            step_wall: Optional[float] = None,
+                            span=None):
+    """Publish one sharded dispatch's telemetry: per-shard device-eval
+    walls, skew verdict, collective wall and padding waste — on the
+    fleet-scoped mesh metrics (KTPU509 holds these write sites to
+    their shard/mesh identity labels) and the ``kyverno/mesh/step``
+    span when the caller passes one.  Returns the skew verdict."""
+    from ..observability import fleet
+    n_dev = mesh.devices.size
+    mesh_key = f'{axis}{n_dev}'
+    devices = [str(d) for d in mesh.devices.flat]
+    verdict = fleet.record_step(mesh_key, shard_walls, devices)
+    registry = fleet.registry()
+    if registry is not None:
+        for i, wall_s in enumerate(shard_walls):
+            registry.observe(fleet.MESH_STEP_DURATION, wall_s,
+                             shard=str(i))
+        if step_wall is not None:
+            registry.observe(fleet.MESH_STEP_DURATION, step_wall,
+                             shard='all')
+        registry.set_gauge(fleet.MESH_SHARD_SKEW, verdict['skew'],
+                           mesh=mesh_key)
+        registry.inc(fleet.MESH_COLLECTIVE_SECONDS, collective_s,
+                     mesh=mesh_key)
+        registry.inc(fleet.MESH_PADDING_ROWS,
+                     float(max(0, padded_rows - n_rows)), mesh=mesh_key)
+    if span is not None:
+        per = padded_rows // max(1, len(shard_walls))
+        occupancy = [min(max(n_rows - i * per, 0), per)
+                     for i in range(len(shard_walls))]
+        span.set_attribute('mesh', mesh_key)
+        span.set_attribute('rows', n_rows)
+        span.set_attribute('padding_rows', max(0, padded_rows - n_rows))
+        span.set_attribute('shard_rows', ','.join(map(str, occupancy)))
+        span.set_attribute('skew', verdict['skew'])
+        span.set_attribute('slow_shard', verdict['slow_shard'])
+        span.set_attribute('collective_s', round(collective_s, 6))
+        if verdict.get('sustained'):
+            span.set_attribute('bound_by', 'straggler')
+    return verdict
+
+
 def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
                           resources: List[dict], axis: str = 'data'):
     """Encode + evaluate a batch across the mesh; returns (statuses, summary).
@@ -144,26 +217,57 @@ def distributed_scan_step(cps: CompiledPolicySet, mesh: Mesh,
     rounded up to a multiple of the mesh size so every shard gets
     identical shapes; the encoder's ``__rowvalid__`` lane keeps the
     padding rows out of the verdict summary.
+
+    With the fleet observatory armed (``observability/fleet.py``;
+    ``KTPU_FLEET=0`` pins it off) every dispatch additionally records
+    per-shard readback-wait splits, the collective wall and padding
+    waste under a ``kyverno/mesh/step`` span — the timing never
+    touches the computed values, so output stays bit-identical.
     """
     from ..compiler.encode import encode_batch
     from ..compiler.shapes import canonical_capacity
+    from ..observability import fleet
+    fl = fleet.enabled()
     n = len(resources)
     n_dev = mesh.devices.size
     padded = pad_to_multiple(
         max(canonical_capacity(max(n, n_dev)), n), n_dev)
-    batch = encode_batch(resources, cps, padded_n=padded)
-    raw = batch.tensors()
-    tensors, layout = shard_tensors(raw, mesh, axis)
-    step = _cached_sharded_evaluator(cps, mesh, axis)
-    statuses, details, summary = step(tensors, layout)
-    if jax.process_count() > 1:
-        # multi-host: each process only holds its local shards of the
-        # batch axis — gather the full status matrix across hosts (the
-        # psum'd summary is already replicated on every device)
-        from jax.experimental import multihost_utils
-        statuses = multihost_utils.process_allgather(statuses, tiled=True)
-    statuses_np = np.asarray(statuses)[:n]
-    summary_np = np.asarray(summary)
+    span_cm = nullcontext()
+    if fl:
+        from ..observability import tracing
+        span_cm = tracing.start_span('kyverno/mesh/step')
+    with span_cm as span:
+        t_start = time.perf_counter() if fl else 0.0
+        batch = encode_batch(resources, cps, padded_n=padded)
+        raw = batch.tensors()
+        tensors, layout = shard_tensors(raw, mesh, axis)
+        step = _cached_sharded_evaluator(cps, mesh, axis)
+        statuses, details, summary = step(tensors, layout)
+        shard_walls = None
+        t_coll = 0.0
+        if fl:
+            shard_walls = shard_wait_splits(statuses)
+            t_coll = time.perf_counter()
+        if jax.process_count() > 1:
+            # multi-host: each process only holds its local shards of
+            # the batch axis — gather the full status matrix across
+            # hosts (the psum'd summary is already replicated on every
+            # device)
+            from jax.experimental import multihost_utils
+            statuses = multihost_utils.process_allgather(statuses,
+                                                         tiled=True)
+        collective_s = 0.0
+        if fl:
+            # the psum'd summary readback (plus the multi-host
+            # allgather above) is the step's cross-shard collective
+            summary.block_until_ready()
+            collective_s = time.perf_counter() - t_coll
+        statuses_np = np.asarray(statuses)[:n]
+        summary_np = np.asarray(summary)
+        if fl:
+            record_sharded_dispatch(
+                mesh, axis, n, padded, shard_walls, collective_s,
+                step_wall=time.perf_counter() - t_start, span=span)
     from ..observability import coverage
     if coverage.enabled():
         # the padded rows are already masked out of the summary, so the

@@ -767,6 +767,103 @@ def test_ktpu508_parameter_fingerprint_undecidable(tmp_path):
     assert not rep.active
 
 
+# every catalog fleet_scope'd metric written from parallel/ with its
+# identity label — the clean state for the KTPU509 fixtures (a partial
+# set would trip the dead-scope check for the missing metrics)
+KTPU509_CLEAN = """\
+def emit(reg, wall):
+    reg.observe('kyverno_tpu_mesh_step_duration_seconds', wall,
+                shard='0')
+    reg.set_gauge('kyverno_tpu_mesh_shard_skew_ratio', 1.0,
+                  mesh='data8')
+    reg.inc('kyverno_tpu_mesh_collective_seconds_total', wall,
+            mesh='data8')
+    reg.inc('kyverno_tpu_mesh_padding_rows_total', 1.0, mesh='data8')
+"""
+
+
+def test_ktpu509_clean_mesh_writes(tmp_path):
+    rep = run(tmp_path, {'parallel/mesh.py': KTPU509_CLEAN},
+              rules=['KTPU509'])
+    assert not rep.active
+
+
+def test_ktpu509_parallel_write_without_scope(tmp_path):
+    # an unscoped metric written from parallel/ loses per-process
+    # attribution in the federation merge
+    rep = run(tmp_path, {'parallel/mesh.py': KTPU509_CLEAN + """\
+
+def bad(reg):
+    reg.inc('kyverno_tpu_host_fallback_total')
+"""}, rules=['KTPU509'])
+    assert rule_ids(rep) == {'KTPU509'}
+    assert any('no fleet_scope' in f.message for f in rep.active)
+
+
+def test_ktpu509_scoped_write_missing_identity_label(tmp_path):
+    missing = KTPU509_CLEAN.replace(
+        "reg.inc('kyverno_tpu_mesh_collective_seconds_total', wall,\n"
+        "            mesh='data8')",
+        "reg.inc('kyverno_tpu_mesh_collective_seconds_total', wall)")
+    rep = run(tmp_path, {'parallel/mesh.py': missing},
+              rules=['KTPU509'])
+    assert rule_ids(rep) == {'KTPU509'}
+    assert any('mesh=' in f.message and 'collective' in f.message
+               for f in rep.active)
+
+
+def test_ktpu509_scoped_write_outside_parallel_still_needs_label(
+        tmp_path):
+    rep = run(tmp_path, {
+        'parallel/mesh.py': KTPU509_CLEAN,
+        'observability/x.py': """\
+def leak(reg):
+    reg.set_gauge('kyverno_tpu_mesh_shard_skew_ratio', 1.0)
+"""}, rules=['KTPU509'])
+    assert rule_ids(rep) == {'KTPU509'}
+
+
+def test_ktpu509_label_splat_is_uncheckable_not_flagged(tmp_path):
+    # **labels keys are unknowable statically — the pass must not guess
+    splat = KTPU509_CLEAN + """\
+
+def forward(reg, wall, labels):
+    reg.inc('kyverno_tpu_mesh_collective_seconds_total', wall,
+            **labels)
+"""
+    rep = run(tmp_path, {'parallel/mesh.py': splat}, rules=['KTPU509'])
+    assert not rep.active
+
+
+def test_ktpu509_dead_scope(tmp_path):
+    # a declared fleet_scope with no parallel/ write site: the scope
+    # promises identity labels nothing emits
+    rep = run(tmp_path, {'a.py': KTPU509_CLEAN}, rules=['KTPU509'])
+    assert rule_ids(rep) == {'KTPU509'}
+    assert all('no parallel/ write site' in f.message
+               for f in rep.active)
+    assert len(rep.active) == 4  # one per scoped catalog metric
+
+
+def test_ktpu509_module_constant_resolution(tmp_path):
+    # names resolve through UPPER_CASE constants, including the
+    # fleet.MESH_* attribute spelling used by parallel/mesh.py
+    rep = run(tmp_path, {'parallel/mesh.py': """\
+MESH_STEP_DURATION = 'kyverno_tpu_mesh_step_duration_seconds'
+MESH_SHARD_SKEW = 'kyverno_tpu_mesh_shard_skew_ratio'
+MESH_COLLECTIVE_SECONDS = 'kyverno_tpu_mesh_collective_seconds_total'
+MESH_PADDING_ROWS = 'kyverno_tpu_mesh_padding_rows_total'
+
+
+def emit(reg, fleet, wall):
+    reg.observe(fleet.MESH_STEP_DURATION, wall, shard='1')
+    reg.set_gauge(MESH_SHARD_SKEW, 1.0, mesh='data8')
+    reg.inc(MESH_COLLECTIVE_SECONDS, wall, mesh='data8')
+    reg.inc(MESH_PADDING_ROWS, 2.0, mesh='data8')
+"""}, rules=['KTPU509'])
+    assert not rep.active
+
+
 # -- KTPU00x: suppression hygiene (meta rules) -------------------------------
 
 def test_ktpu001_positive_negative(tmp_path):
@@ -910,7 +1007,7 @@ def test_rule_registry_complete():
                 'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
                 'KTPU401', 'KTPU402',
                 'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505',
-                'KTPU506', 'KTPU507', 'KTPU508'}
+                'KTPU506', 'KTPU507', 'KTPU508', 'KTPU509'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
