@@ -38,3 +38,4 @@ from . import retrace  # noqa: F401,E402
 from . import taxonomy  # noqa: F401,E402
 from . import envreg  # noqa: F401,E402
 from . import catalog_pass  # noqa: F401,E402
+from . import concurrency  # noqa: F401,E402

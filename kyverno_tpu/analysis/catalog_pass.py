@@ -106,6 +106,27 @@ def _module_constants(tree: ast.Module) -> Dict[str, str]:
     return consts
 
 
+def _consts(sf: SourceFile) -> Dict[str, str]:
+    """Per-file memo of ``_module_constants`` — several collectors and
+    passes re-read the same files, and the constant map never changes
+    within a run."""
+    cached = getattr(sf, '_catalog_consts', None)
+    if cached is None:
+        cached = _module_constants(sf.tree)
+        sf._catalog_consts = cached
+    return cached
+
+
+def _write_sites(ctx: Context):
+    return ctx.cached('catalog:writes',
+                      lambda: collect_from_files(ctx.files))
+
+
+def _span_sites(ctx: Context):
+    return ctx.cached('catalog:spans',
+                      lambda: collect_span_sites(ctx.files))
+
+
 def collect_from_files(files: List[SourceFile]
                        ) -> Tuple[List[Tuple[SourceFile, int, str]],
                                   List[Tuple[SourceFile, int, str]]]:
@@ -114,14 +135,14 @@ def collect_from_files(files: List[SourceFile]
     all_consts: Dict[str, str] = {}
     for sf in files:
         if sf.tree is not None:
-            all_consts.update(_module_constants(sf.tree))
+            all_consts.update(_consts(sf))
     resolved: List[Tuple[SourceFile, int, str]] = []
     unresolved: List[Tuple[SourceFile, int, str]] = []
     for sf in files:
         if sf.tree is None:
             continue
-        local_consts = _module_constants(sf.tree)
-        for node in ast.walk(sf.tree):
+        local_consts = _consts(sf)
+        for node in sf.walk():
             if not (isinstance(node, ast.Call) and
                     isinstance(node.func, ast.Attribute) and
                     node.func.attr in WRITE_METHODS and node.args):
@@ -153,7 +174,7 @@ def load_catalog() -> Dict[str, Tuple[str, str]]:
                      'observability/catalog.py')
 def _check_uncataloged(ctx: Context) -> Iterable[Finding]:
     catalog = load_catalog()
-    resolved, _unresolved = collect_from_files(ctx.files)
+    resolved, _unresolved = _write_sites(ctx)
     for sf, line, name in resolved:
         if name not in catalog:
             yield sf.finding(
@@ -165,7 +186,7 @@ def _check_uncataloged(ctx: Context) -> Iterable[Finding]:
 @register('KTPU502', 'metric write site whose name is not a literal '
                      'or module constant (uncheckable)')
 def _check_unresolved(ctx: Context) -> Iterable[Finding]:
-    _resolved, unresolved = collect_from_files(ctx.files)
+    _resolved, unresolved = _write_sites(ctx)
     for sf, line, desc in unresolved:
         yield sf.finding(
             'KTPU502', line,
@@ -192,7 +213,7 @@ def stale_allowlist_entries(catalog, used) -> List[Tuple[str, str]]:
                      'in the tree (or stale allowlist entry)')
 def _check_dead_metrics(ctx: Context) -> Iterable[Finding]:
     catalog = load_catalog()
-    resolved, _unresolved = collect_from_files(ctx.files)
+    resolved, _unresolved = _write_sites(ctx)
     used = {name for _sf, _l, name in resolved}
     anchor = ctx.by_rel('kyverno_tpu/observability/catalog.py')
 
@@ -259,15 +280,15 @@ def collect_span_sites(files: List[SourceFile]
     all_consts: Dict[str, str] = {}
     for sf in files:
         if sf.tree is not None:
-            all_consts.update(_module_constants(sf.tree))
+            all_consts.update(_consts(sf))
     exact: List[Tuple[SourceFile, int, str]] = []
     dynamic: List[Tuple[SourceFile, int, str]] = []
     unresolved: List[Tuple[SourceFile, int, str]] = []
     for sf in files:
         if sf.tree is None:
             continue
-        local_consts = _module_constants(sf.tree)
-        for node in ast.walk(sf.tree):
+        local_consts = _consts(sf)
+        for node in sf.walk():
             if not (isinstance(node, ast.Call) and node.args):
                 continue
             func = node.func
@@ -304,7 +325,7 @@ def collect_span_sites(files: List[SourceFile]
                      'or unresolvable')
 def _check_uncataloged_spans(ctx: Context) -> Iterable[Finding]:
     catalog = load_span_catalog()
-    exact, dynamic, unresolved = collect_span_sites(ctx.files)
+    exact, dynamic, unresolved = _span_sites(ctx)
     for sf, line, name in exact:
         if name not in catalog:
             yield sf.finding(
@@ -330,7 +351,7 @@ def _check_uncataloged_spans(ctx: Context) -> Iterable[Finding]:
                      'site in the tree')
 def _check_dead_spans(ctx: Context) -> Iterable[Finding]:
     catalog = load_span_catalog()
-    exact, dynamic, _unresolved = collect_span_sites(ctx.files)
+    exact, dynamic, _unresolved = _span_sites(ctx)
     used = {name for _sf, _l, name in exact}
     for _sf, _l, prefix in dynamic:
         if prefix:
@@ -379,13 +400,13 @@ def collect_labeled_writes(files: List[SourceFile]
     all_consts: Dict[str, str] = {}
     for sf in files:
         if sf.tree is not None:
-            all_consts.update(_module_constants(sf.tree))
+            all_consts.update(_consts(sf))
     sites: List[Tuple[SourceFile, int, str, Optional[frozenset]]] = []
     for sf in files:
         if sf.tree is None:
             continue
-        local_consts = _module_constants(sf.tree)
-        for node in ast.walk(sf.tree):
+        local_consts = _consts(sf)
+        for node in sf.walk():
             if not (isinstance(node, ast.Call) and
                     isinstance(node.func, ast.Attribute) and
                     node.func.attr in WRITE_METHODS and node.args):
@@ -415,7 +436,8 @@ def collect_labeled_writes(files: List[SourceFile]
                      'fleet_scope')
 def _check_fleet_scope(ctx: Context) -> Iterable[Finding]:
     scopes = load_fleet_scopes()
-    sites = collect_labeled_writes(ctx.files)
+    sites = ctx.cached('catalog:labeled',
+                       lambda: collect_labeled_writes(ctx.files))
     exercised: set = set()
     for sf, line, name, keys in sites:
         rel = '/' + sf.rel.replace(os.sep, '/')
@@ -484,7 +506,7 @@ def collect_stage_labels(files: List[SourceFile]
     for sf in files:
         if sf.tree is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not (isinstance(node, ast.Call) and node.args):
                 continue
             func = node.func
@@ -667,11 +689,33 @@ def _check_unit_mismatch(ctx: Context) -> Iterable[Finding]:
     all_consts: Dict[str, str] = {}
     for sf in ctx.files:
         if sf.tree is not None:
-            all_consts.update(_module_constants(sf.tree))
+            all_consts.update(_consts(sf))
     for sf in ctx.files:
         if sf.tree is None:
             continue
-        local_consts = _module_constants(sf.tree)
+        local_consts = _consts(sf)
+
+        def _unit_of(node):
+            arg = node.args[0]
+            name: Optional[str] = None
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.Name):
+                name = local_consts.get(arg.id, all_consts.get(arg.id))
+            elif isinstance(arg, ast.Attribute):
+                name = all_consts.get(arg.attr)
+            return (name, _metric_unit(name)
+                    if name is not None else None)
+
+        # cheap pre-filter off the per-file node index: the expensive
+        # per-scope binding walk only runs for the handful of files
+        # that write a unit-suffixed metric at all
+        if not any(isinstance(n.func, ast.Attribute) and
+                   n.func.attr in _VALUE_METHODS and n.args and
+                   _unit_of(n)[1] is not None
+                   for n in sf.nodes_of(ast.Call)):
+            continue
         for scope in _iter_scopes(sf.tree):
             bindings = _scope_bindings(scope)
             for node in _scope_nodes(scope):
@@ -679,17 +723,7 @@ def _check_unit_mismatch(ctx: Context) -> Iterable[Finding]:
                         isinstance(node.func, ast.Attribute) and
                         node.func.attr in _VALUE_METHODS and node.args):
                     continue
-                arg = node.args[0]
-                name: Optional[str] = None
-                if isinstance(arg, ast.Constant) and \
-                        isinstance(arg.value, str):
-                    name = arg.value
-                elif isinstance(arg, ast.Name):
-                    name = local_consts.get(arg.id,
-                                            all_consts.get(arg.id))
-                elif isinstance(arg, ast.Attribute):
-                    name = all_consts.get(arg.attr)
-                unit = _metric_unit(name) if name is not None else None
+                name, unit = _unit_of(node)
                 if unit is None:
                     continue
                 value = _value_arg(node)
@@ -821,10 +855,11 @@ def render_span_table() -> str:
 # -- standalone API for the scripts/check_metric_names.py shim ---------------
 
 def default_sources() -> List[str]:
-    """The historical checker file set: the package, scripts/, and
-    bench.py."""
-    return [PACKAGE, os.path.join(REPO_ROOT, 'scripts'),
-            os.path.join(REPO_ROOT, 'bench.py')]
+    """The checker file set, rooted at the repo — one list
+    (``core.DEFAULT_SOURCE_PATHS``) shared with ``scripts/analyze.py``
+    so the standalone catalog checker and the driver can't drift."""
+    from .core import DEFAULT_SOURCE_PATHS
+    return [os.path.join(REPO_ROOT, p) for p in DEFAULT_SOURCE_PATHS]
 
 
 def collect_call_sites() -> Tuple[List[Tuple[str, int, str]],

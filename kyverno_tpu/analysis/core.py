@@ -63,7 +63,14 @@ class Noqa:
 
 
 class SourceFile:
-    """One parsed source file: AST + per-line noqa directives."""
+    """One parsed source file: AST + per-line noqa directives.
+
+    Also the per-file AST memo: :meth:`walk` flattens the tree once
+    and :meth:`nodes_of` indexes it by node type once, so a dozen
+    passes asking "every Call in this file" cost one traversal total
+    instead of one ``ast.walk`` each — the difference between the
+    analyzer fitting its 10s tier-1 budget and not.
+    """
 
     def __init__(self, path: str, rel: str, text: str):
         self.path = path
@@ -72,6 +79,8 @@ class SourceFile:
         self.lines = text.splitlines()
         self.tree: Optional[ast.Module] = None
         self.syntax_error: Optional[str] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._by_type: Dict[type, List[ast.AST]] = {}
         try:
             self.tree = ast.parse(text, filename=path)
         except SyntaxError as e:
@@ -93,6 +102,24 @@ class SourceFile:
                                         (m.group(2) or '').strip())
         except (tokenize.TokenError, IndentationError):
             pass  # syntax_error already recorded above
+
+    def walk(self) -> List[ast.AST]:
+        """Every node in the file, flattened once and memoized."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree)) \
+                if self.tree is not None else []
+        return self._nodes
+
+    def nodes_of(self, *types: type) -> List[ast.AST]:
+        """Every node of the given type(s), from a memoized per-type
+        index (``isinstance``-exact: pass each concrete type)."""
+        out: List[ast.AST] = []
+        for t in types:
+            if t not in self._by_type:
+                self._by_type[t] = [n for n in self.walk()
+                                    if type(n) is t]
+            out.extend(self._by_type[t])
+        return out
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -153,7 +180,20 @@ class Context:
 
 # -- file collection ---------------------------------------------------------
 
-_SKIP_DIRS = {'__pycache__', '.git', '.cache', 'node_modules'}
+#: the ONE directory exclude list every walker shares — the driver
+#: (``scripts/analyze.py``), :func:`collect_files`, and the
+#: ``catalog_pass`` shim all consume this instead of keeping private
+#: copies that drift.  ``tests`` is excluded because fixture strings
+#: deliberately contain violations; caches/VCS dirs never hold source.
+EXCLUDE_DIRS = frozenset({
+    '__pycache__', '.git', '.cache', 'node_modules', 'tests',
+    'fixtures',
+})
+
+#: the default analyzed file set, shared by the driver and the
+#: standalone checker shims (``scripts/`` is *included* by intent —
+#: the lint tooling lints itself; ``tests/`` is excluded above)
+DEFAULT_SOURCE_PATHS = ('kyverno_tpu', 'scripts', 'bench.py')
 
 
 def collect_files(paths: List[str], root: str) -> List[SourceFile]:
@@ -166,7 +206,7 @@ def collect_files(paths: List[str], root: str) -> List[SourceFile]:
         else:
             cands = []
             for base, dirs, names in os.walk(ap):
-                dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+                dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
                 cands.extend(os.path.join(base, n) for n in sorted(names)
                              if n.endswith('.py'))
         for c in sorted(cands):

@@ -55,7 +55,7 @@ def env_reads(ctx: Context) -> List[Tuple[SourceFile, ast.AST, str]]:
         for sf in ctx.files:
             if sf.tree is None:
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 name = _env_read_name(node)
                 if name is not None and name.startswith(PREFIX):
                     out.append((sf, node, name))

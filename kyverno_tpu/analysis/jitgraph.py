@@ -1,25 +1,53 @@
-"""Jit-entry call graph: which functions can run under a trace.
+"""Qualified-name interprocedural engine: call graph + taint lattice.
 
-The trace-safety (KTPU1xx) and retrace (KTPU2xx) passes share one
-over-approximated reachability question: *could this function's body
-execute inside ``jax.jit``?*  Entry points are functions passed to
-``jax.jit`` / ``pjit`` (call form) or decorated with them; edges are
-resolved statically:
+The trace-safety (KTPU1xx), retrace (KTPU2xx), and concurrency
+(KTPU6xx) passes share two whole-program questions this module
+answers:
 
-* bare-name calls → defs in the same file (any nesting level);
-* ``from M import f`` calls → ``f``'s top-level def in ``M`` when ``M``
-  is part of the analyzed tree (relative imports resolved against the
-  importing module's package, function-level imports included);
-* ``alias.f(...)`` calls where ``alias`` imports a tree module → that
-  module's ``f``;
-* ``obj.method(...)`` calls → same-file defs named ``method`` when the
-  name is unambiguous there (covers ``self.x`` and helper-class
-  methods without pretending to do type inference).
+1. *Could this function's body execute inside ``jax.jit``* (or on a
+   ``threading.Thread``)?  — reachability over a **resolved** call
+   graph.
+2. *Does this value derive from a traced argument?* — a param-rooted
+   **taint lattice** over that graph.
 
-This deliberately over-approximates (a shared method name pulls in
-every same-file homonym) — for lint purposes a false reachable edge
-costs a reviewed suppression, a false unreachable edge hides a real
-host sync.
+**Binder (two passes).**  Pass one indexes every module in a single
+tree traversal: defs by name, classes with their methods, import
+aliases (relative imports resolved against the importing package),
+parent links, and *assignment-tracked receiver types one level deep*
+(``x = SomeClass(...)`` at module level or locally,
+``self.attr = SomeClass(...)`` inside methods).  Pass two resolves
+call sites against those indexes:
+
+* bare ``f(...)`` → same-file defs (any nesting level), then
+  ``from M import f`` targets, then class constructors (edge to
+  ``__init__``);
+* ``alias.f(...)`` where ``alias`` imports a tree module → that
+  module's ``f`` (or class ``f``'s ``__init__``);
+* ``self.m(...)`` inside a method of class ``C`` → ``C.m`` (walking
+  one level of resolvable bases) — **qualified**, no same-file
+  homonym over-approximation;
+* ``obj.m(...)`` / ``self.attr.m(...)`` where the receiver's type
+  was assignment-tracked → that class's ``m``;
+* anything else with an *unknown* receiver keeps the historical
+  over-approximation (same-file homonym defs) — a false reachable
+  edge costs a reviewed suppression, a false unreachable edge hides
+  a real host sync.
+
+**Taint.**  Every non-static parameter of a jit entry is
+tracer-tainted at depth 0.  Taint propagates through local
+assignments, call arguments (tainted arg → callee param, depth+1),
+and return values (a callee that returns a tainted expression taints
+the call result), bounded at ``KTPU_LINT_TAINT_DEPTH`` call edges
+(default 3): a cast at depth 3 is a finding, the same cast at depth 4
+is silence.  Static shape metadata (``.shape``/``.ndim``/``.dtype``/
+``len()``) deliberately launders taint — those are Python ints under
+trace.  Each tainted function carries a representative entry→here
+call chain for the finding message.
+
+Everything is memoized per :class:`Context` — resolution results per
+function, taint summaries per (function, tainted-params) state — and
+the per-file AST memo on :class:`SourceFile` keeps the whole build
+single-traversal per file.
 """
 
 from __future__ import annotations
@@ -27,11 +55,34 @@ from __future__ import annotations
 import ast
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Context, SourceFile
 
 FuncKey = Tuple[str, int]  # (file rel, def lineno)
+
+#: taint propagation bound, in call edges from the jit entry
+TAINT_DEPTH_DEFAULT = 3
+
+
+def taint_depth() -> int:
+    """Interprocedural taint bound (``KTPU_LINT_TAINT_DEPTH``)."""
+    raw = os.environ.get('KTPU_LINT_TAINT_DEPTH', '')
+    try:
+        return int(raw) if raw else TAINT_DEPTH_DEFAULT
+    except ValueError:
+        return TAINT_DEPTH_DEFAULT
+
+
+#: attribute reads that are static under trace — shape metadata is a
+#: Python int/dtype, so taint does not flow through them
+STATIC_ATTRS = {'shape', 'ndim', 'dtype', 'size', 'weak_type'}
+
+#: builtins whose result is host-static even over a traced argument
+STATIC_BUILTINS = {'len', 'isinstance', 'type', 'id', 'repr', 'str',
+                   'hash', 'callable', 'getattr', 'hasattr', 'range'}
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
 def walk_scope(fn: ast.AST):
@@ -48,13 +99,43 @@ def walk_scope(fn: ast.AST):
 
 
 @dataclass
+class ClassInfo:
+    node: ast.ClassDef
+    name: str
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    bases: List[ast.expr] = field(default_factory=list)
+    #: ``self.<attr> = Ctor(...)`` sites: attr -> type token
+    attr_types: Dict[str, Tuple] = field(default_factory=dict)
+    #: first ``self.<attr> = <value>`` site per attr — the value node
+    #: (KTPU201 checks these for mutable-container initializers)
+    attr_values: Dict[str, ast.AST] = field(default_factory=dict)
+
+
+@dataclass
+class FuncInfo:
+    node: ast.AST
+    rel: str
+    qualname: str            # module-dotted + lexical path
+    cls: Optional[str]       # immediately enclosing class, if a method
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.rel, self.node.lineno)
+
+
+@dataclass
 class ModuleInfo:
     sf: SourceFile
     dotted: Optional[str]                      # dotted module name, if known
     defs: Dict[str, List[ast.AST]] = field(default_factory=dict)
-    # local name -> ('module', dotted) | ('func', dotted, name)
+    # local name -> ('module', dotted) | ('from', src, name)
     imports: Dict[str, Tuple] = field(default_factory=dict)
     parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    #: module-level ``NAME = Ctor(...)``: name -> type token
+    var_types: Dict[str, Tuple] = field(default_factory=dict)
+    #: every def in the file -> its FuncInfo
+    func_info: Dict[ast.AST, FuncInfo] = field(default_factory=dict)
 
 
 def _dotted_for(rel: str) -> Optional[str]:
@@ -84,6 +165,19 @@ def _resolve_relative(dotted: Optional[str], level: int,
     return '.'.join(base + (module.split('.') if module else []))
 
 
+def _type_token(ctor: ast.AST) -> Optional[Tuple]:
+    """Type token for an ``x = Ctor(...)`` right-hand side: the
+    constructor's spelling, resolved lazily against module indexes."""
+    if not isinstance(ctor, ast.Call):
+        return None
+    f = ctor.func
+    if isinstance(f, ast.Name):
+        return ('local', f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return ('attr', f.value.id, f.attr)
+    return None
+
+
 class JitGraph:
     def __init__(self, ctx: Context):
         self.modules: Dict[str, ModuleInfo] = {}
@@ -92,32 +186,108 @@ class JitGraph:
             if sf.tree is None:
                 continue
             mi = ModuleInfo(sf, _dotted_for(sf.rel))
-            for node in ast.walk(sf.tree):
-                for child in ast.iter_child_nodes(node):
-                    mi.parents[child] = node
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    mi.defs.setdefault(node.name, []).append(node)
-                elif isinstance(node, ast.Import):
-                    for alias in node.names:
-                        mi.imports[alias.asname or
-                                   alias.name.split('.')[0]] = \
-                            ('module', alias.name)
-                elif isinstance(node, ast.ImportFrom):
-                    src = _resolve_relative(mi.dotted, node.level,
-                                            node.module)
-                    if src is None:
-                        continue
-                    for alias in node.names:
-                        local = alias.asname or alias.name
-                        mi.imports[local] = ('from', src, alias.name)
+            self._bind_module(mi)
             self.modules[sf.rel] = mi
             if mi.dotted:
                 self.by_dotted[mi.dotted] = mi
+        self._callee_cache: Dict[FuncKey, List[Tuple]] = {}
+        self._local_type_cache: Dict[FuncKey, Dict[str, Tuple]] = {}
+        self._return_taint_memo: Dict[Tuple[FuncKey, frozenset],
+                                      bool] = {}
+        self._tainted_locals_memo: Dict[Tuple[FuncKey, frozenset],
+                                        Set[str]] = {}
+        self._scope_cache: Dict[FuncKey, List[ast.AST]] = {}
         self.entries: List[Tuple[ModuleInfo, ast.AST, ast.AST]] = []
         self._find_entries()
         self.reachable: Set[FuncKey] = set()
         self._walk_reachable()
+        #: merged tracer-tainted parameter names per function
+        self.taint: Dict[FuncKey, Set[str]] = {}
+        #: min call-edge distance from a jit entry, taint-bounded walk
+        self.taint_min_depth: Dict[FuncKey, int] = {}
+        #: representative entry→function qualname chain
+        self.taint_chain: Dict[FuncKey, Tuple[str, ...]] = {}
+        self._propagate_taint()
+
+    # -- binder pass 1: per-module indexes -----------------------------------
+
+    def _bind_module(self, mi: ModuleInfo) -> None:
+        """Single recursive traversal building every per-module index:
+        parents, defs, imports, classes/methods, receiver types."""
+        mod_prefix = mi.dotted or mi.sf.rel
+
+        def visit(node: ast.AST, qual: str, cls: Optional[ClassInfo],
+                  in_func: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                mi.parents[child] = node
+                if isinstance(child, _FUNC_DEFS):
+                    mi.defs.setdefault(child.name, []).append(child)
+                    qn = f'{qual}.{child.name}' if qual else child.name
+                    mi.func_info[child] = FuncInfo(
+                        child, mi.sf.rel, f'{mod_prefix}:{qn}',
+                        cls.name if cls is not None else None)
+                    if cls is not None:
+                        cls.methods.setdefault(child.name, child)
+                    visit(child, qn, None, True)
+                elif isinstance(child, ast.ClassDef):
+                    qn = f'{qual}.{child.name}' if qual else child.name
+                    ci = ClassInfo(child, child.name,
+                                   bases=list(child.bases))
+                    # outermost same-name class wins; nested/shadowed
+                    # definitions keep their own methods map
+                    mi.classes.setdefault(child.name, ci)
+                    visit(child, qn, ci, in_func)
+                elif isinstance(child, ast.Import):
+                    for alias in child.names:
+                        mi.imports[alias.asname or
+                                   alias.name.split('.')[0]] = \
+                            ('module', alias.name)
+                    visit(child, qual, cls, in_func)
+                elif isinstance(child, ast.ImportFrom):
+                    src = _resolve_relative(mi.dotted, child.level,
+                                            child.module)
+                    if src is not None:
+                        for alias in child.names:
+                            local = alias.asname or alias.name
+                            mi.imports[local] = ('from', src, alias.name)
+                    visit(child, qual, cls, in_func)
+                elif isinstance(child, ast.Assign):
+                    tok = _type_token(child.value)
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name) and not in_func:
+                            if tok is not None:
+                                mi.var_types.setdefault(tgt.id, tok)
+                        elif isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == 'self' and \
+                                cls is None and in_func:
+                            # parent links exist up to `child`;
+                            # resolve the owner from there
+                            owner = self._owning_class(mi, child)
+                            if owner is not None:
+                                if tok is not None:
+                                    owner.attr_types.setdefault(
+                                        tgt.attr, tok)
+                                owner.attr_values.setdefault(
+                                    tgt.attr, child.value)
+                    visit(child, qual, cls, in_func)
+                else:
+                    visit(child, qual, cls, in_func)
+
+        visit(mi.sf.tree, '', None, False)
+
+    def _owning_class(self, mi: ModuleInfo,
+                      node: ast.AST) -> Optional[ClassInfo]:
+        """The ClassInfo whose method lexically contains ``node``."""
+        cur = mi.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                ci = mi.classes.get(cur.name)
+                if ci is not None and ci.node is cur:
+                    return ci
+                return mi.classes.get(cur.name)
+            cur = mi.parents.get(cur)
+        return None
 
     # -- entry detection -----------------------------------------------------
 
@@ -133,16 +303,18 @@ class JitGraph:
 
     def _find_entries(self) -> None:
         for mi in self.modules.values():
-            tree = mi.sf.tree
-            for node in ast.walk(tree):
+            for node in mi.sf.walk():
                 if isinstance(node, ast.Call) and \
                         self.is_jit_callable(node.func) and node.args:
                     target = node.args[0]
                     if isinstance(target, ast.Name):
                         for d in mi.defs.get(target.id, []):
                             self.entries.append((mi, d, node))
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
+                    elif isinstance(target, ast.Attribute):
+                        for tmi, d in self._resolve_attr_call(
+                                mi, None, target):
+                            self.entries.append((tmi, d, node))
+                if isinstance(node, _FUNC_DEFS):
                     for dec in node.decorator_list:
                         call = dec if isinstance(dec, ast.Call) else None
                         if self.is_jit_callable(dec) or (
@@ -152,42 +324,244 @@ class JitGraph:
                                         for a in call.args))):
                             self.entries.append((mi, node, dec))
 
-    # -- reachability --------------------------------------------------------
-
-    def _callees(self, mi: ModuleInfo, fn: ast.AST
-                 ) -> List[Tuple[ModuleInfo, ast.AST]]:
-        out: List[Tuple[ModuleInfo, ast.AST]] = []
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name):
-                name = f.id
-                if name in mi.defs:
-                    out.extend((mi, d) for d in mi.defs[name])
-                    continue
-                imp = mi.imports.get(name)
-                if imp and imp[0] == 'from':
-                    tgt = self.by_dotted.get(imp[1])
-                    if tgt is not None:
-                        out.extend((tgt, d)
-                                   for d in tgt.defs.get(imp[2], []))
-            elif isinstance(f, ast.Attribute):
-                base = f.value
-                if isinstance(base, ast.Name):
-                    imp = mi.imports.get(base.id)
-                    if imp is not None:
-                        if imp[0] == 'module':
-                            tgt = self.by_dotted.get(imp[1])
-                        else:
-                            tgt = self.by_dotted.get(f'{imp[1]}.{imp[2]}')
-                        if tgt is not None:
-                            out.extend((tgt, d)
-                                       for d in tgt.defs.get(f.attr, []))
-                            continue
-                # unqualified method call: same-file defs by attr name
-                out.extend((mi, d) for d in mi.defs.get(f.attr, []))
+    @staticmethod
+    def _static_entry_params(fn: ast.AST, site: ast.AST) -> Set[str]:
+        """Param names pinned static at the jit site
+        (``static_argnums`` / ``static_argnames``)."""
+        out: Set[str] = set()
+        if not isinstance(site, ast.Call):
+            return out
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in site.keywords:
+            val = kw.value
+            if kw.arg == 'static_argnums':
+                nums = val.elts if isinstance(
+                    val, (ast.Tuple, ast.List)) else [val]
+                for n in nums:
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, int) and \
+                            0 <= n.value < len(pos):
+                        out.add(pos[n.value])
+            elif kw.arg == 'static_argnames':
+                names = val.elts if isinstance(
+                    val, (ast.Tuple, ast.List)) else [val]
+                for n in names:
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, str):
+                        out.update(s.strip()
+                                   for s in n.value.split(','))
         return out
+
+    def entry_tainted_params(self, fn: ast.AST,
+                             site: ast.AST) -> Set[str]:
+        """The entry's tracer-tainted parameter names: every param
+        except ``self``/``cls`` and the site's static args."""
+        static = self._static_entry_params(fn, site)
+        args = fn.args
+        names = [a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs]
+        return {n for n in names
+                if n not in static and n not in ('self', 'cls')}
+
+    # -- binder pass 2: call resolution --------------------------------------
+
+    def _resolve_class(self, mi: ModuleInfo, token: Tuple
+                       ) -> Optional[Tuple[ModuleInfo, ClassInfo]]:
+        """Resolve a type token to an in-tree class, chasing one
+        import hop."""
+        if token[0] == 'local':
+            name = token[1]
+            ci = mi.classes.get(name)
+            if ci is not None:
+                return mi, ci
+            imp = mi.imports.get(name)
+            if imp is not None and imp[0] == 'from':
+                tgt = self.by_dotted.get(imp[1])
+                if tgt is not None:
+                    ci = tgt.classes.get(imp[2])
+                    if ci is not None:
+                        return tgt, ci
+        elif token[0] == 'attr':
+            imp = mi.imports.get(token[1])
+            if imp is not None:
+                dotted = imp[1] if imp[0] == 'module' \
+                    else f'{imp[1]}.{imp[2]}'
+                tgt = self.by_dotted.get(dotted)
+                if tgt is not None:
+                    ci = tgt.classes.get(token[2])
+                    if ci is not None:
+                        return tgt, ci
+        return None
+
+    def _class_method(self, mi: ModuleInfo, ci: ClassInfo, name: str,
+                      _depth: int = 0
+                      ) -> Optional[Tuple[ModuleInfo, ast.AST]]:
+        """Look ``name`` up on ``ci``, then one level of resolvable
+        base classes."""
+        m = ci.methods.get(name)
+        if m is not None:
+            return mi, m
+        if _depth >= 2:
+            return None
+        for base in ci.bases:
+            tok = None
+            if isinstance(base, ast.Name):
+                tok = ('local', base.id)
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name):
+                tok = ('attr', base.value.id, base.attr)
+            if tok is None:
+                continue
+            resolved = self._resolve_class(mi, tok)
+            if resolved is not None:
+                hit = self._class_method(resolved[0], resolved[1],
+                                         name, _depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _local_types(self, mi: ModuleInfo,
+                     fn: Optional[ast.AST]) -> Dict[str, Tuple]:
+        """``x = Ctor(...)`` receiver types local to ``fn`` (one
+        assignment hop, memoized)."""
+        if fn is None:
+            return {}
+        key = (mi.sf.rel, fn.lineno)
+        hit = self._local_type_cache.get(key)
+        if hit is not None:
+            return hit
+        out: Dict[str, Tuple] = {}
+        for node in self.scope_nodes(mi, fn):
+            if isinstance(node, ast.Assign):
+                tok = _type_token(node.value)
+                if tok is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, tok)
+        self._local_type_cache[key] = out
+        return out
+
+    def _receiver_token(self, mi: ModuleInfo, fn: Optional[ast.AST],
+                        base: ast.AST) -> Optional[Tuple]:
+        """Type token of a call receiver expression, if tracked."""
+        if isinstance(base, ast.Name):
+            tok = self._local_types(mi, fn).get(base.id)
+            if tok is not None:
+                return tok
+            return mi.var_types.get(base.id)
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == 'self' and fn is not None:
+            info = mi.func_info.get(fn)
+            if info is not None and info.cls is not None:
+                ci = mi.classes.get(info.cls)
+                if ci is not None:
+                    return ci.attr_types.get(base.attr)
+        return None
+
+    def _resolve_attr_call(self, mi: ModuleInfo, fn: Optional[ast.AST],
+                           f: ast.Attribute
+                           ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        base = f.value
+        # self.m() → the enclosing class's method, qualified
+        if isinstance(base, ast.Name) and base.id == 'self' and \
+                fn is not None:
+            info = mi.func_info.get(fn)
+            if info is not None and info.cls is not None:
+                ci = mi.classes.get(info.cls)
+                if ci is not None:
+                    hit = self._class_method(mi, ci, f.attr)
+                    if hit is not None:
+                        return [hit]
+                    tok = ci.attr_types.get(f.attr)
+                    if tok is not None:
+                        # self.attr holds a tracked instance and is
+                        # being *called*: jit(self.fn)-style callables
+                        resolved = self._resolve_class(mi, tok)
+                        if resolved is not None:
+                            hit = self._class_method(
+                                resolved[0], resolved[1], '__call__')
+                            if hit is not None:
+                                return [hit]
+                    return []  # per-class lookup is authoritative
+        # typed receiver (local/module var, self.attr) → that class
+        tok = self._receiver_token(mi, fn, base)
+        if tok is not None:
+            resolved = self._resolve_class(mi, tok)
+            if resolved is not None:
+                hit = self._class_method(resolved[0], resolved[1],
+                                         f.attr)
+                return [hit] if hit is not None else []
+        # alias.f() → the imported module's f (def or class ctor)
+        if isinstance(base, ast.Name):
+            imp = mi.imports.get(base.id)
+            if imp is not None:
+                dotted = imp[1] if imp[0] == 'module' \
+                    else f'{imp[1]}.{imp[2]}'
+                tgt = self.by_dotted.get(dotted)
+                if tgt is not None:
+                    out = [(tgt, d) for d in tgt.defs.get(f.attr, [])
+                           if self._is_top_level(tgt, d)]
+                    ci = tgt.classes.get(f.attr)
+                    if ci is not None and '__init__' in ci.methods:
+                        out.append((tgt, ci.methods['__init__']))
+                    if out or imp[0] == 'module':
+                        return out
+        # unknown receiver: historical same-file homonym fallback
+        return [(mi, d) for d in mi.defs.get(f.attr, [])]
+
+    @staticmethod
+    def _is_top_level(mi: ModuleInfo, d: ast.AST) -> bool:
+        return isinstance(mi.parents.get(d), ast.Module)
+
+    def resolve_call(self, mi: ModuleInfo, fn: Optional[ast.AST],
+                     call: ast.Call
+                     ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        """Resolve one call site to its in-tree target def(s)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in mi.defs:
+                return [(mi, d) for d in mi.defs[name]]
+            ci = mi.classes.get(name)
+            if ci is not None:
+                init = ci.methods.get('__init__')
+                return [(mi, init)] if init is not None else []
+            imp = mi.imports.get(name)
+            if imp is not None and imp[0] == 'from':
+                tgt = self.by_dotted.get(imp[1])
+                if tgt is not None:
+                    out = [(tgt, d) for d in tgt.defs.get(imp[2], [])
+                           if self._is_top_level(tgt, d)]
+                    ci = tgt.classes.get(imp[2])
+                    if ci is not None and '__init__' in ci.methods:
+                        out.append((tgt, ci.methods['__init__']))
+                    return out
+            return []
+        if isinstance(f, ast.Attribute):
+            return self._resolve_attr_call(mi, fn, f)
+        return []
+
+    def callees(self, mi: ModuleInfo, fn: ast.AST
+                ) -> List[Tuple[ModuleInfo, ast.AST, ast.Call]]:
+        """Every resolved call edge out of ``fn`` (memoized).  Walks
+        the full subtree including nested defs — a closure's calls run
+        when the closure does, and the closure is only reachable via
+        its enclosing function."""
+        key = (mi.sf.rel, fn.lineno)
+        hit = self._callee_cache.get(key)
+        if hit is not None:
+            return hit
+        out: List[Tuple[ModuleInfo, ast.AST, ast.Call]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                for tmi, d in self.resolve_call(mi, fn, node):
+                    out.append((tmi, d, node))
+        self._callee_cache[key] = out
+        return out
+
+    # -- reachability --------------------------------------------------------
 
     def _walk_reachable(self) -> None:
         work: List[Tuple[ModuleInfo, ast.AST]] = \
@@ -198,13 +572,233 @@ class JitGraph:
             if key in self.reachable:
                 continue
             self.reachable.add(key)
-            work.extend(self._callees(mi, fn))
+            work.extend((tmi, d) for tmi, d, _c in self.callees(mi, fn))
+
+    def reachable_set(self, mi: ModuleInfo,
+                      fn: ast.AST) -> Set[FuncKey]:
+        """Transitive closure of call edges from ``fn`` (inclusive) —
+        the reachability primitive the KTPU6xx thread passes reuse."""
+        seen: Set[FuncKey] = set()
+        work = [(mi, fn)]
+        while work:
+            cmi, cfn = work.pop()
+            key = (cmi.sf.rel, cfn.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            work.extend((tmi, d)
+                        for tmi, d, _c in self.callees(cmi, cfn))
+        return seen
+
+    # -- taint lattice -------------------------------------------------------
+
+    def _bind_args(self, callee: ast.AST, call: ast.Call,
+                   is_method_call: bool) -> List[Tuple[str, ast.AST]]:
+        """(param name, arg expr) pairs for a resolved call."""
+        args = callee.args
+        pos = [a.arg for a in args.posonlyargs + args.args]
+        if is_method_call and pos and pos[0] in ('self', 'cls'):
+            pos = pos[1:]
+        out: List[Tuple[str, ast.AST]] = []
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(pos):
+                out.append((pos[i], a))
+        kw_ok = {a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in kw_ok:
+                out.append((kw.arg, kw.value))
+        return out
+
+    def expr_tainted(self, mi: ModuleInfo, fn: Optional[ast.AST],
+                     expr: ast.AST, tainted: Set[str],
+                     _depth: int = 0) -> bool:
+        """Does ``expr`` (under ``tainted`` names) carry a tracer?
+        Shape metadata and host-static builtins launder taint; calls
+        consult the callee's return-taint summary."""
+        if _depth > 6:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(mi, fn, expr.value, tainted,
+                                     _depth + 1)
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name) and f.id in STATIC_BUILTINS:
+                return False
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and \
+                    root.id in ('jnp', 'jax', 'lax'):
+                return True
+            targets = self.resolve_call(mi, fn, expr) \
+                if fn is not None else []
+            if targets:
+                for tmi, d in targets:
+                    bound = self._bind_args(
+                        d, expr, isinstance(f, ast.Attribute))
+                    sub = {p for p, a in bound
+                           if self.expr_tainted(mi, fn, a, tainted,
+                                                _depth + 1)}
+                    if sub and self.returns_tainted(tmi, d,
+                                                    frozenset(sub)):
+                        return True
+                # a method *on* a tainted receiver stays tainted even
+                # when the callee body is opaque (t.sum(), t.astype())
+            if isinstance(f, ast.Attribute) and not targets and \
+                    self.expr_tainted(mi, fn, f.value, tainted,
+                                      _depth + 1):
+                return True
+            return any(self.expr_tainted(mi, fn, a, tainted, _depth + 1)
+                       for a in expr.args) and not targets
+        return any(self.expr_tainted(mi, fn, c, tainted, _depth + 1)
+                   for c in ast.iter_child_nodes(expr))
+
+    def tainted_locals(self, mi: ModuleInfo, fn: ast.AST,
+                       params: Set[str]) -> Set[str]:
+        """Tainted names visible in ``fn``: the tainted params plus
+        locals assigned (transitively, to a small fixpoint) from
+        tainted expressions."""
+        memo_key = ((mi.sf.rel, fn.lineno), frozenset(params))
+        hit = self._tainted_locals_memo.get(memo_key)
+        if hit is not None:
+            return set(hit)
+        tainted = set(params)
+        assigns = [n for n in self.scope_nodes(mi, fn)
+                   if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign))]
+        for _round in range(3):
+            grew = False
+            for node in assigns:
+                value = node.value
+                if value is None or not self.expr_tainted(
+                        mi, fn, value, tainted):
+                    continue
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    elts = t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name) and \
+                                e.id not in tainted:
+                            tainted.add(e.id)
+                            grew = True
+            if not grew:
+                break
+        self._tainted_locals_memo[memo_key] = set(tainted)
+        return tainted
+
+    def returns_tainted(self, mi: ModuleInfo, fn: ast.AST,
+                        params: frozenset) -> bool:
+        """Does ``fn`` return a tainted value when ``params`` are
+        tainted?  Memoized; cycles assume False (under-approximate —
+        a missed return edge costs a missed finding, never a false
+        one)."""
+        key = ((mi.sf.rel, fn.lineno), params)
+        hit = self._return_taint_memo.get(key)
+        if hit is not None:
+            return hit
+        self._return_taint_memo[key] = False  # cycle guard
+        tainted = self.tainted_locals(mi, fn, set(params))
+        result = False
+        for node in self.scope_nodes(mi, fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self.expr_tainted(mi, fn, node.value, tainted):
+                    result = True
+                    break
+        self._return_taint_memo[key] = result
+        return result
+
+    def _propagate_taint(self) -> None:
+        limit = taint_depth()
+        work: List[FuncKey] = []
+        infos: Dict[FuncKey, Tuple[ModuleInfo, ast.AST]] = {}
+        for mi, fn, site in self.entries:
+            key = (mi.sf.rel, fn.lineno)
+            params = self.entry_tainted_params(fn, site)
+            if not params:
+                continue
+            infos[key] = (mi, fn)
+            prev = self.taint.setdefault(key, set())
+            if not params <= prev or key not in self.taint_min_depth:
+                prev.update(params)
+                self.taint_min_depth[key] = 0
+                info = mi.func_info.get(fn)
+                self.taint_chain.setdefault(
+                    key, (info.qualname if info else fn.name,))
+                work.append(key)
+        while work:
+            key = work.pop()
+            mi, fn = infos[key]
+            depth = self.taint_min_depth[key]
+            if depth >= limit:
+                continue
+            params = set(self.taint[key])
+            tainted = self.tainted_locals(mi, fn, params)
+            for tmi, d, call in self.callees(mi, fn):
+                bound = self._bind_args(
+                    d, call, isinstance(call.func, ast.Attribute))
+                sub = {p for p, a in bound
+                       if self.expr_tainted(mi, fn, a, tainted)}
+                if not sub:
+                    continue
+                tkey = (tmi.sf.rel, d.lineno)
+                prev = self.taint.setdefault(tkey, set())
+                old_depth = self.taint_min_depth.get(tkey)
+                new_depth = depth + 1
+                changed = not sub <= prev
+                prev.update(sub)
+                if old_depth is None or new_depth < old_depth:
+                    self.taint_min_depth[tkey] = new_depth
+                    changed = True
+                if tkey not in self.taint_chain:
+                    info = tmi.func_info.get(d)
+                    self.taint_chain[tkey] = self.taint_chain[key] + \
+                        (info.qualname if info else d.name,)
+                if changed:
+                    infos[tkey] = (tmi, d)
+                    work.append(tkey)
+
+    def tainted_names_for(self, mi: ModuleInfo,
+                          fn: ast.AST) -> Set[str]:
+        """Tainted params ∪ tainted locals for a reachable function
+        (empty when taint never reaches it)."""
+        params = self.taint.get((mi.sf.rel, fn.lineno))
+        if not params:
+            return set()
+        return self.tainted_locals(mi, fn, params)
+
+    def chain_for(self, mi: ModuleInfo, fn: ast.AST) -> str:
+        """Rendered entry→function call chain for finding messages."""
+        chain = self.taint_chain.get((mi.sf.rel, fn.lineno))
+        if not chain:
+            return ''
+        return ' -> '.join(chain)
 
     # -- queries -------------------------------------------------------------
 
+    def scope_nodes(self, mi: ModuleInfo,
+                    fn: ast.AST) -> List[ast.AST]:
+        """Memoized :func:`walk_scope` — every pass asking for a
+        function's own-scope nodes shares one traversal."""
+        key = (mi.sf.rel, fn.lineno)
+        hit = self._scope_cache.get(key)
+        if hit is None:
+            hit = list(walk_scope(fn))
+            self._scope_cache[key] = hit
+        return hit
+
     def reachable_functions(self):
-        """Yield ``(SourceFile, FunctionDef)`` for every function whose
-        body may execute under a jit trace."""
+        """Yield ``(SourceFile, ModuleInfo, FunctionDef)`` for every
+        function whose body may execute under a jit trace."""
         for mi in self.modules.values():
             for defs in mi.defs.values():
                 for d in defs:
@@ -222,6 +816,54 @@ class JitGraph:
                 out.append(node)
             node = mi.parents.get(node)
         return out
+
+    def function_by_name(self, name: str
+                         ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        """Every def matching ``name`` — bare (``_worker``), qualified
+        (``ChunkPipeline._worker``), or dotted-module-prefixed
+        (``kyverno_tpu.compiler.pipeline:ChunkPipeline._worker``) —
+        for ``--graph-dump``."""
+        out: List[Tuple[ModuleInfo, ast.AST]] = []
+        for mi in self.modules.values():
+            for info in mi.func_info.values():
+                qn = info.qualname
+                short = qn.split(':', 1)[1] if ':' in qn else qn
+                if name in (qn, short, short.split('.')[-1]):
+                    out.append((mi, info.node))
+        return out
+
+    def graph_dump(self, mi: ModuleInfo, fn: ast.AST) -> dict:
+        """Resolved callees + taint facts for one function (the
+        ``--graph-dump`` payload)."""
+        info = mi.func_info.get(fn)
+        key = (mi.sf.rel, fn.lineno)
+        callees = []
+        seen = set()
+        for tmi, d, call in self.callees(mi, fn):
+            tinfo = tmi.func_info.get(d)
+            ck = (tmi.sf.rel, d.lineno, call.lineno)
+            if ck in seen:
+                continue
+            seen.add(ck)
+            callees.append({
+                'qualname': tinfo.qualname if tinfo else d.name,
+                'file': tmi.sf.rel, 'line': d.lineno,
+                'call_line': call.lineno,
+                'jit_reachable': (tmi.sf.rel, d.lineno)
+                                 in self.reachable})
+        return {
+            'qualname': info.qualname if info else fn.name,
+            'file': mi.sf.rel, 'line': fn.lineno,
+            'class': info.cls if info else None,
+            'jit_reachable': key in self.reachable,
+            'callees': callees,
+            'taint': {
+                'params': sorted(self.taint.get(key, ())),
+                'depth': self.taint_min_depth.get(key),
+                'chain': list(self.taint_chain.get(key, ())),
+                'names': sorted(self.tainted_names_for(mi, fn)),
+            },
+        }
 
 
 def jit_graph(ctx: Context) -> JitGraph:

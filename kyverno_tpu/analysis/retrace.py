@@ -140,6 +140,31 @@ def _check_mutable_closure(ctx: Context) -> Iterable[Finding]:
                     f'`{name}` from an enclosing scope — the trace '
                     f'captures its trace-time contents; freeze it '
                     f'(tuple) or pass it as an argument')
+        # `self.X` closure reads: a jitted *method* closes over its
+        # instance, so a mutable-container attribute is exactly the
+        # module-global hazard above — the binder's per-class
+        # `self.X = ...` sites tell us which attrs are containers
+        fi = mi.func_info.get(fn)
+        ci = mi.classes.get(fi.cls) if fi is not None and \
+            fi.cls is not None else None
+        if ci is not None:
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Attribute) and
+                        isinstance(node.ctx, ast.Load) and
+                        isinstance(node.value, ast.Name) and
+                        node.value.id == 'self'):
+                    continue
+                attr = node.attr
+                if attr in flagged:
+                    continue
+                if _is_mutable_container(ci.attr_values.get(attr)):
+                    flagged.add(attr)
+                    yield mi.sf.finding(
+                        'KTPU201', node,
+                        f'jit-wrapped method `{fn.name}` reads mutable '
+                        f'container `self.{attr}` — the trace captures '
+                        f'its trace-time contents; freeze it (tuple) '
+                        f'or pass it as an argument')
 
 
 def _static_params(call: ast.Call, fn: ast.AST) -> List[Tuple[str, ast.AST]]:
@@ -319,7 +344,7 @@ def _check_hot_path_allocs(ctx: Context) -> Iterable[Finding]:
         if sf.tree is None:
             continue
         defs: dict = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault(node.name, node)
         entries = [defs[n] for n in sorted(_HOT_ENTRIES) if n in defs]

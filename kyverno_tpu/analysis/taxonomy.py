@@ -142,7 +142,7 @@ def _check_reason_values(ctx: Context) -> Iterable[Finding]:
         if rel.replace(os.sep, '/').endswith(
                 'observability/coverage.py'):
             continue
-        for node in ast.walk(mi.sf.tree):
+        for node in mi.sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             arg = _reason_arg(node)
@@ -245,7 +245,7 @@ def _check_dead_reasons(ctx: Context) -> Iterable[Finding]:
         if rel.replace(os.sep, '/').endswith(
                 'observability/coverage.py'):
             continue
-        for node in ast.walk(mi.sf.tree):
+        for node in mi.sf.walk():
             if isinstance(node, ast.Call):
                 arg = _reason_arg(node)
                 if isinstance(arg, ast.Constant) and \
@@ -314,7 +314,7 @@ def _check_swallowed_serving_errors(ctx: Context) -> Iterable[Finding]:
         parts = rel.replace(os.sep, '/').split('/')
         if 'serving' not in parts and parts[-1] != 'pipeline.py':
             continue
-        for node in ast.walk(mi.sf.tree):
+        for node in mi.sf.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _is_broad_except(node) or _handler_attributes(node):

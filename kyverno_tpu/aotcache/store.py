@@ -238,5 +238,10 @@ def publish_stats(store: Optional[AotStore] = None) -> None:
     if reg is None:
         return
     st = (store or default_store()).stats()
+    # ktpu: noqa[KTPU603] -- cache bytes describe the on-disk store,
+    # which outlives the process; the last sample stays true after a
+    # drain, so reset-on-close would be wrong here
     reg.set_gauge(AOT_CACHE_SIZE_BYTES, float(st['bytes']))
+    # ktpu: noqa[KTPU603] -- same as above: entry count is persistent
+    # store state, not live process occupancy
     reg.set_gauge(AOT_CACHE_ENTRIES, float(st['entries']))

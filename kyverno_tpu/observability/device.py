@@ -288,6 +288,9 @@ def record_cache(result: str) -> None:
 
 def set_batch_size(n: int) -> None:
     if _registry is not None:
+        # ktpu: noqa[KTPU603] -- the canonical batch capacity is
+        # configuration, not occupancy; it stays meaningful after a
+        # drain and resetting it to 0 would misreport the shape table
         _registry.set_gauge(DEVICE_BATCH_SIZE, float(n))
 
 
@@ -359,10 +362,14 @@ class D2HWatchdog:
             self._stopped = True
             self._entries.clear()
             self._cv.notify()
-        t = self._thread
+            t = self._thread
         if t is not None:
             t.join(timeout=2)
-            self._thread = None
+            # arm() reads/writes _thread under the condition variable;
+            # clearing it outside raced a concurrent arm (join must
+            # stay outside — _run holds the cv between waits)
+            with self._cv:
+                self._thread = None
 
     def _run(self) -> None:
         with self._cv:

@@ -220,6 +220,9 @@ class ExecutableLedger:
         for rec in self._records.values():
             if not rec.evicted:
                 counts[rec.source] = counts.get(rec.source, 0) + 1
+        # the ledger's live record count is residency — a shut-down
+        # process holds no executables, so the series must drain to 0
+        reg.mark_reset_on_close(EXEC_COUNT)
         for source, n in counts.items():
             reg.set_gauge(EXEC_COUNT, float(n), source=source)
 

@@ -181,6 +181,10 @@ class SloEngine:
         reg = self.registry or global_registry()
         if reg is None:
             return
+        # burn rate is a live condition of THIS process — a drained
+        # server must export 0, not its last degraded sample
+        reg.mark_reset_on_close(SLO_BURN_RATE)
+        reg.mark_reset_on_close(SLO_BUDGET_REMAINING)
         reg.set_gauge(SLO_BURN_RATE, round(burn_short, 6), window='short')
         reg.set_gauge(SLO_BURN_RATE, round(burn_long, 6), window='long')
         reg.set_gauge(SLO_BUDGET_REMAINING, round(remaining, 6))

@@ -187,6 +187,9 @@ def record_sharded_dispatch(mesh: Mesh, axis: str, n_rows: int,
         if step_wall is not None:
             registry.observe(fleet.MESH_STEP_DURATION, step_wall,
                              shard='all')
+        # skew describes the mesh step in flight — reset-on-close so a
+        # drained host doesn't export its last imbalance forever
+        registry.mark_reset_on_close(fleet.MESH_SHARD_SKEW)
         registry.set_gauge(fleet.MESH_SHARD_SKEW, verdict['skew'],
                            mesh=mesh_key)
         registry.inc(fleet.MESH_COLLECTIVE_SECONDS, collective_s,

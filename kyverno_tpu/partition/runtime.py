@@ -176,6 +176,8 @@ def build_runtime(policies: Sequence, whole_cps, n_parts: int,
             reg.inc(PARTITION_RECOMPILES, float(fresh))
         if reused:
             reg.inc(PARTITION_REUSES, float(reused))
+        # live partition-runtime occupancy: must read 0 once drained
+        reg.mark_reset_on_close(PARTITION_COUNT)
         reg.set_gauge(PARTITION_COUNT, float(len(runtimes)))
     return PartitionedSet(plan=plan, runtimes=tuple(runtimes),
                           set_fingerprint=set_fingerprint)

@@ -29,10 +29,9 @@ sys.path.insert(0, REPO_ROOT)
 
 from kyverno_tpu.analysis import (Analyzer, RULES, load_baseline,  # noqa: E402
                                   write_baseline)
-from kyverno_tpu.analysis.core import DEFAULT_BASELINE  # noqa: E402
+from kyverno_tpu.analysis.core import (DEFAULT_BASELINE,  # noqa: E402
+                                       DEFAULT_SOURCE_PATHS)
 from kyverno_tpu.analysis.knobs import render_knob_table  # noqa: E402
-
-DEFAULT_PATHS = ['kyverno_tpu', 'scripts', 'bench.py']
 
 
 def main(argv=None) -> int:
@@ -62,6 +61,11 @@ def main(argv=None) -> int:
                     help='print the generated README debug-endpoint '
                          'table (profiling-server route registry)')
     ap.add_argument('--list-rules', action='store_true')
+    ap.add_argument('--graph-dump', default=None, metavar='FN',
+                    help='debug: print the resolved callees and taint '
+                         'facts for one function (bare name, '
+                         'Class.method, or module:qualname); '
+                         'honors --json')
     args = ap.parse_args(argv)
 
     if args.knob_table:
@@ -80,7 +84,7 @@ def main(argv=None) -> int:
             print(f'{rid}  {RULES[rid].summary}')
         return 0
 
-    paths = args.paths or [p for p in DEFAULT_PATHS
+    paths = args.paths or [p for p in DEFAULT_SOURCE_PATHS
                            if os.path.exists(os.path.join(REPO_ROOT, p))]
     baseline = None if args.no_baseline else \
         (args.baseline or os.path.join(REPO_ROOT, DEFAULT_BASELINE))
@@ -88,6 +92,47 @@ def main(argv=None) -> int:
         if args.rules else None
     analyzer = Analyzer(paths, REPO_ROOT, baseline_path=baseline,
                         rules=rules)
+
+    if args.graph_dump:
+        from kyverno_tpu.analysis.jitgraph import jit_graph
+        graph = jit_graph(analyzer.ctx)
+        matches = graph.function_by_name(args.graph_dump)
+        if not matches:
+            print(f'no function matches {args.graph_dump!r}',
+                  file=sys.stderr)
+            return 2
+        dumps = [graph.graph_dump(mi, fn) for mi, fn in matches]
+        if args.as_json:
+            print(json.dumps(dumps, indent=2))
+        else:
+            for d in dumps:
+                print(f'{d["qualname"]}  ({d["file"]}:{d["line"]})')
+                print(f'  jit-reachable: {d["jit_reachable"]}')
+                if d.get('class'):
+                    print(f'  class: {d["class"]}')
+                print('  callees:')
+                if not d['callees']:
+                    print('    (none resolved)')
+                for c in d['callees']:
+                    reach = ' [jit-reachable]' if c['jit_reachable'] \
+                        else ''
+                    print(f'    {c["qualname"]}  ({c["file"]}:'
+                          f'{c["line"]}, called at line '
+                          f'{c["call_line"]}){reach}')
+                taint = d.get('taint') or {}
+                if taint.get('params'):
+                    print(f'  tainted params (depth '
+                          f'{taint.get("depth")}): '
+                          f'{", ".join(taint["params"])}')
+                    if taint.get('chain'):
+                        print(f'  taint chain: '
+                              f'{" -> ".join(taint["chain"])}')
+                    print(f'  tainted locals: '
+                          f'{", ".join(taint.get("names", [])) or "-"}')
+                else:
+                    print('  tainted params: (none)')
+        return 0
+
     report = analyzer.run()
 
     if args.write_baseline:

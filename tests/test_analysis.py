@@ -79,12 +79,15 @@ def test_ktpu102_positive_negative(tmp_path):
     jf = jax.jit(f)
     """}, rules=['KTPU102'])
     assert rule_ids(rep) == {'KTPU102'}
+    # a *static* jit arg is a plain python value — casting it is fine;
+    # without static_argnames the param is a tracer and the cast is a
+    # finding (see test_taint_entry_param below)
     rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
     def f(t, n):
         return t * int(n)
-    jf = jax.jit(f)
+    jf = jax.jit(f, static_argnames='n')
     """}, rules=['KTPU102'])
-    assert not rep.active  # cast of a plain python value
+    assert not rep.active  # cast of a static python value
 
 
 def test_ktpu103_positive_negative(tmp_path):
@@ -1007,7 +1010,8 @@ def test_rule_registry_complete():
                 'KTPU301', 'KTPU302', 'KTPU303', 'KTPU304',
                 'KTPU401', 'KTPU402',
                 'KTPU501', 'KTPU502', 'KTPU503', 'KTPU504', 'KTPU505',
-                'KTPU506', 'KTPU507', 'KTPU508', 'KTPU509'}
+                'KTPU506', 'KTPU507', 'KTPU508', 'KTPU509',
+                'KTPU601', 'KTPU602', 'KTPU603', 'KTPU604'}
     assert set(RULES) == expected
     for rid, rule in RULES.items():
         assert rule.summary.strip(), rid
@@ -1018,3 +1022,314 @@ def test_knob_table_renders_every_knob():
     table = render_knob_table()
     for name in KNOBS:
         assert f'`{name}`' in table
+
+
+# -- v2 call graph: qualified resolution -------------------------------------
+
+def test_callgraph_alias_import(tmp_path):
+    """`import helpers as h; h.helper(t)` resolves across files — the
+    finding lands in the helper's module."""
+    rep = run(tmp_path, {
+        'helpers.py': """\
+    def helper(t):
+        return t.tolist()
+    """,
+        'entry.py': JIT_PRELUDE + """\
+    import helpers as h
+
+    def f(t):
+        return h.helper(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+    assert {f.path for f in rep.active} == {'helpers.py'}
+
+
+def test_callgraph_class_method_dispatch(tmp_path):
+    """`self.m()` and assignment-typed receivers dispatch to the
+    owning class's method, one level deep."""
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    class Evaluator:
+        def prep(self, t):
+            return t.tolist()
+
+        def run(self, t):
+            return self.prep(t)
+
+    ev = Evaluator()
+
+    def f(t):
+        return ev.run(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert rule_ids(rep) == {'KTPU101'}
+    # per-class dispatch is authoritative: a same-name method on an
+    # unrelated class must NOT be pulled into the graph
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    class A:
+        def go(self, t):
+            return t
+
+    class B:
+        def go(self, t):
+            return t.tolist()
+
+    a = A()
+
+    def f(t):
+        return a.go(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert not rep.active
+
+
+def test_callgraph_diamond_chain(tmp_path):
+    """f -> a -> d and f -> b -> d: the shared sink is analyzed (and
+    reported) exactly once."""
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def d(t):
+        return t.item()
+
+    def a(t):
+        return d(t)
+
+    def b(t):
+        return d(t)
+
+    def f(t):
+        return a(t) + b(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU101'])
+    assert len(rep.active) == 1
+    assert rep.active[0].rule_id == 'KTPU101'
+
+
+# -- v2 param-rooted taint ---------------------------------------------------
+
+def test_taint_entry_param(tmp_path):
+    """A non-static jit entry param is a tracer: casting it anywhere
+    is a finding, and static_argnums exempts exactly that param."""
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t, n):
+        return t * int(n)
+    jf = jax.jit(f)
+    """}, rules=['KTPU102'])
+    assert rule_ids(rep) == {'KTPU102'}
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def f(t, n):
+        return t * int(n)
+    jf = jax.jit(f, static_argnums=(1,))
+    """}, rules=['KTPU102'])
+    assert not rep.active
+
+
+def test_taint_depth_boundary(tmp_path):
+    """Default KTPU_LINT_TAINT_DEPTH=3: a cast of a param three call
+    edges below the entry fires; four edges down, taint has stopped."""
+    chain = JIT_PRELUDE + """\
+    def h3(x):
+        return int(x)
+
+    def h2(x):
+        return h3(x)
+
+    def h1(x):
+        return h2(x)
+
+    def f(t):
+        return h1(t)
+    jf = jax.jit(f)
+    """
+    rep = run(tmp_path, {'a.py': chain}, rules=['KTPU102'])
+    assert rule_ids(rep) == {'KTPU102'}
+    assert 'call chain' in rep.active[0].message
+    deeper = chain.replace('def h3(x):\n        return int(x)',
+                           'def h4(x):\n'
+                           '        return int(x)\n\n'
+                           '    def h3(x):\n'
+                           '        return h4(x)')
+    rep = run(tmp_path, {'a.py': deeper}, rules=['KTPU102'])
+    assert not rep.active
+
+
+def test_taint_depth_knob(tmp_path, monkeypatch):
+    """KTPU_LINT_TAINT_DEPTH tightens the propagation bound."""
+    monkeypatch.setenv('KTPU_LINT_TAINT_DEPTH', '1')
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def h2(x):
+        return int(x)
+
+    def h1(x):
+        return h2(x)
+
+    def f(t):
+        return h1(t)
+    jf = jax.jit(f)
+    """}, rules=['KTPU102'])
+    assert not rep.active  # the cast sits at depth 2, past the bound
+
+
+def test_callgraph_real_world_miss(tmp_path):
+    """Planted miss modeled on ops/eval.py before the tuple-freeze fix
+    (PR 4): the tracer-concretizing branch lives two helpers below the
+    jit entry, where the old one-level pass could not see it."""
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    def _threshold(counts):
+        if counts > 0:
+            return counts
+        return 0
+
+    def _score(batch):
+        return _threshold(batch)
+
+    def eval_batch(batch):
+        return _score(batch)
+    jf = jax.jit(eval_batch)
+    """}, rules=['KTPU103'])
+    assert rule_ids(rep) == {'KTPU103'}
+    [f] = rep.active
+    assert '_threshold' in f.message
+    assert 'call chain' in f.message
+
+
+def test_ktpu201_self_attr_closure(tmp_path):
+    """A jitted *method* closing over a mutable `self.X` container is
+    the same stale-closure hazard as a module global (the old pass
+    only saw bare names)."""
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    class Model:
+        def __init__(self):
+            self.table = {}
+
+        def step(self, t):
+            return t + len(self.table)
+
+    m = Model()
+    jstep = jax.jit(m.step)
+    """}, rules=['KTPU201'])
+    assert rule_ids(rep) == {'KTPU201'}
+    assert 'self.table' in rep.active[0].message
+    rep = run(tmp_path, {'a.py': JIT_PRELUDE + """\
+    class Model:
+        def __init__(self):
+            self.table = (1, 2)
+
+        def step(self, t):
+            return t + len(self.table)
+
+    m = Model()
+    jstep = jax.jit(m.step)
+    """}, rules=['KTPU201'])
+    assert not rep.active  # a tuple attribute cannot drift
+
+
+# -- KTPU6xx: concurrency discipline -----------------------------------------
+
+def test_ktpu601_positive_negative(tmp_path):
+    pos = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            self.n = 1
+
+        def bump(self):
+            with self._lock:
+                self.n = 2
+    """
+    rep = run(tmp_path, {'a.py': pos}, rules=['KTPU601'])
+    assert rule_ids(rep) == {'KTPU601'}
+    rep = run(tmp_path, {'a.py': pos.replace(
+        '        def _run(self):\n            self.n = 1',
+        '        def _run(self):\n'
+        '            with self._lock:\n'
+        '                self.n = 1')}, rules=['KTPU601'])
+    assert not rep.active
+
+
+def test_ktpu602_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    import threading
+
+    def worker():
+        with stage('encode'):
+            pass
+
+    def start():
+        t = threading.Thread(target=worker)
+        t.start()
+    """}, rules=['KTPU602'])
+    assert rule_ids(rep) == {'KTPU602'}
+    rep = run(tmp_path, {'a.py': """\
+    import threading
+
+    def worker():
+        install_capture(None)
+        with stage('encode'):
+            pass
+
+    def start():
+        t = threading.Thread(target=worker)
+        t.start()
+    """}, rules=['KTPU602'])
+    assert not rep.active
+
+
+def test_ktpu603_positive_negative(tmp_path):
+    pos = """\
+    G = 'kyverno_tpu_queue_depth'
+
+    def loop(reg, q):
+        while True:
+            reg.set_gauge(G, float(len(q)))
+    """
+    rep = run(tmp_path, {'a.py': pos}, rules=['KTPU603'])
+    assert rule_ids(rep) == {'KTPU603'}
+    rep = run(tmp_path, {'a.py': pos + """\
+
+    def setup(reg):
+        reg.mark_reset_on_close(G)
+    """}, rules=['KTPU603'])
+    assert not rep.active
+
+
+def test_ktpu604_positive_negative(tmp_path):
+    rep = run(tmp_path, {'a.py': """\
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with B:
+            with A:
+                pass
+    """}, rules=['KTPU604'])
+    assert rule_ids(rep) == {'KTPU604'}
+    rep = run(tmp_path, {'a.py': """\
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with A:
+            with B:
+                pass
+    """}, rules=['KTPU604'])
+    assert not rep.active

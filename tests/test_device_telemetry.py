@@ -182,6 +182,22 @@ class TestWatchdog:
             time.sleep(0.01)
         assert not _watchdog_threads()
 
+    def test_stop_clears_thread_under_lock(self):
+        """`stop()` must write `_thread` under the condition variable
+        (arm() reads and writes it there); after stop the slot is
+        cleared, a second stop is a no-op, and a post-stop arm is
+        refused without resurrecting the thread."""
+        from kyverno_tpu.observability.device import D2HWatchdog
+        wd = D2HWatchdog(threshold_s=10.0)
+        token = wd.arm()
+        assert token >= 0 and wd._thread is not None
+        wd.disarm(token)
+        wd.stop()
+        assert wd._thread is None
+        wd.stop()  # idempotent
+        assert wd.arm() == -1  # stopped watchdogs refuse new arms
+        assert wd._thread is None
+
 
 class TestNoopWhenUnconfigured:
     def test_scan_allocates_nothing(self, scanner):

@@ -161,6 +161,20 @@ class TestBurnMath:
         assert reg.gauge_value(SLO_BURN_RATE, window='short') == 10.0
         assert reg.gauge_value(SLO_BUDGET_REMAINING) == -9.0
 
+    def test_burn_gauges_reset_on_close(self):
+        """Burn rate and budget are live conditions of this process:
+        the publish path marks them reset-on-close, so a drained
+        server scrapes as healthy (0), not as its last degraded
+        sample."""
+        reg = MetricsRegistry()
+        eng, _ = make_engine(registry=reg)
+        eng.record('batch', 0.500)
+        assert reg.gauge_value(SLO_BURN_RATE, window='short') == 10.0
+        reg.reset_residency_gauges()
+        assert reg.gauge_value(SLO_BURN_RATE, window='short') == 0.0
+        assert reg.gauge_value(SLO_BURN_RATE, window='long') == 0.0
+        assert reg.gauge_value(SLO_BUDGET_REMAINING) == 0.0
+
     def test_snapshot_per_path_digests(self):
         eng, _ = make_engine()
         for _ in range(98):

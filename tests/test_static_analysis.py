@@ -76,6 +76,26 @@ def test_analyzer_catches_planted_violation(tmp_path):
         os.unlink(rogue)
 
 
+def test_graph_dump_debug_mode():
+    """--graph-dump prints resolved callees + taint facts for a named
+    function, and --json emits a machine-readable dump."""
+    proc = _run_analyzer('--graph-dump', 'ChunkPipeline._worker')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'ChunkPipeline._worker' in proc.stdout
+    assert 'callees:' in proc.stdout
+    assert 'install_capture' in proc.stdout  # resolved cross-module
+    proc = _run_analyzer('--graph-dump', 'ChunkPipeline._worker',
+                         '--json')
+    assert proc.returncode == 0
+    dumps = json.loads(proc.stdout)
+    assert dumps and dumps[0]['class'] == 'ChunkPipeline'
+    assert any(c['qualname'].endswith('install_capture')
+               for c in dumps[0]['callees'])
+    # unknown names are a distinct exit code, not a crash
+    proc = _run_analyzer('--graph-dump', 'no_such_function_xyz')
+    assert proc.returncode == 2
+
+
 def test_knob_table_matches_registry():
     """--knob-table output covers every registered knob, and the README
     carries the generated table (docs cannot drift from the registry)."""
